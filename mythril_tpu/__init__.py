@@ -1,0 +1,19 @@
+"""mythril_tpu — a TPU-native EVM bytecode security analyzer.
+
+A ground-up rebuild of the capabilities of Mythril (symbolic execution of
+EVM bytecode + SMT-backed vulnerability detection), designed TPU-first:
+
+- the path-exploration frontier is a structure-of-arrays batch stepped
+  under `jax.vmap`/`pjit`,
+- satisfiability checks are bit-blasted to fixed-shape clause tensors and
+  solved by batched JAX/Pallas kernels on device,
+- a self-contained CPU word-level + CDCL solver provides the ground-truth
+  oracle (this environment ships no z3),
+- corpus-level parallelism fans contracts out across a `jax.sharding.Mesh`.
+
+Layer map mirrors the reference (see SURVEY.md):
+L7 CLI (interfaces/) -> L6 orchestration (core.py) -> L5 analysis/ ->
+L4 laser/ engine -> L1 smt/ -> L0 utils/ & support/.
+"""
+
+from mythril_tpu.version import __version__  # noqa: F401

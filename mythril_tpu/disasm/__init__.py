@@ -1,0 +1,4 @@
+"""Bytecode -> instruction stream, EASM rendering, selector discovery."""
+
+from mythril_tpu.disasm.asm import Instr, disassemble, instrs_to_easm  # noqa: F401
+from mythril_tpu.disasm.disassembly import Disassembly  # noqa: F401

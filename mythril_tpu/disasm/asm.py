@@ -1,0 +1,106 @@
+"""Linear-sweep EVM disassembler.
+
+Behavioral parity with reference mythril/disassembler/asm.py (bytes ->
+instruction records with address/opcode/argument, EASM text). The record is
+a NamedTuple rather than a dict so the engine can index it cheaply.
+"""
+
+import re
+from typing import List, NamedTuple, Optional
+
+from mythril_tpu.support import opcodes
+
+
+class Instr(NamedTuple):
+    address: int          # byte offset in the code
+    opcode: str           # mnemonic, e.g. "PUSH2"
+    byte: int             # raw opcode byte
+    argument: Optional[bytes]  # PUSH operand, else None
+
+    @property
+    def argument_int(self) -> Optional[int]:
+        return int.from_bytes(self.argument, "big") if self.argument is not None else None
+
+    def to_easm(self) -> str:
+        if self.argument is not None:
+            return f"{self.address} {self.opcode} 0x{self.argument.hex()}"
+        return f"{self.address} {self.opcode}"
+
+
+def strip_metadata(code: bytes) -> bytes:
+    """Drop the CBOR metadata trailer solc appends (…a264…0033 / …a165…)."""
+    if len(code) >= 2:
+        trailer_len = int.from_bytes(code[-2:], "big")
+        if 0 < trailer_len <= len(code) - 2:
+            candidate = code[-(trailer_len + 2):-2]
+            # CBOR map header 0xa1/0xa2 with 'ipfs'/'bzzr'/'solc' keys
+            if candidate[:1] in (b"\xa1", b"\xa2") and (
+                b"ipfs" in candidate or b"bzzr" in candidate or b"solc" in candidate
+            ):
+                return code[: -(trailer_len + 2)]
+    return code
+
+
+def disassemble(code: bytes) -> List[Instr]:
+    """Linear sweep; PUSH operands are consumed (truncated operand is padded)."""
+    out: List[Instr] = []
+    pc = 0
+    length = len(code)
+    while pc < length:
+        byte = code[pc]
+        name = opcodes.name_of(byte)
+        width = opcodes.push_width(name)
+        if width:
+            operand = code[pc + 1 : pc + 1 + width]
+            if len(operand) < width:
+                operand = operand + b"\x00" * (width - len(operand))
+            out.append(Instr(pc, name, byte, operand))
+            pc += 1 + width
+        else:
+            out.append(Instr(pc, name, byte, None))
+            pc += 1
+    return out
+
+
+def instrs_to_easm(instrs: List[Instr]) -> str:
+    return "\n".join(i.to_easm() for i in instrs) + "\n"
+
+
+_EASM_LINE = re.compile(
+    r"^(?:(\d+)\s+)?([A-Z][A-Z0-9]*|UNKNOWN_0x[0-9a-fA-F]{2})(?:\s+0x([0-9a-fA-F]+))?$"
+)
+
+
+def easm_to_code(easm: str) -> bytes:
+    """Assemble EASM text back to bytecode (used by tests and the assembler)."""
+    blob = bytearray()
+    for line in easm.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        match = _EASM_LINE.match(line)
+        if not match:
+            raise ValueError(f"cannot parse EASM line: {line!r}")
+        _, mnemonic, arg_hex = match.groups()
+        if mnemonic.startswith("UNKNOWN_0x"):
+            if arg_hex is not None:
+                raise ValueError(f"{mnemonic} takes no operand: {line!r}")
+            blob.append(int(mnemonic[10:], 16))
+            continue
+        spec = opcodes.BY_NAME.get(mnemonic)
+        if spec is None:
+            raise ValueError(f"unknown mnemonic {mnemonic!r}")
+        blob.append(spec.byte)
+        width = opcodes.push_width(mnemonic)
+        if width:
+            if arg_hex is None:
+                raise ValueError(f"{mnemonic} needs an operand")
+            try:
+                blob += int(arg_hex, 16).to_bytes(width, "big")
+            except OverflowError:
+                raise ValueError(
+                    f"operand 0x{arg_hex} does not fit {mnemonic}: {line!r}"
+                ) from None
+        elif arg_hex is not None:
+            raise ValueError(f"{mnemonic} takes no operand: {line!r}")
+    return bytes(blob)

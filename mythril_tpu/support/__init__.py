@@ -1,0 +1,1 @@
+"""Support layer: opcode metadata, global flag singleton, time budget, caches."""

@@ -1,0 +1,6 @@
+"""Version of the mythril_tpu framework.
+
+Reference parity target: mythril v0.24.8 (reference mythril/__version__.py:7).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,73 @@
+from mythril_tpu.disasm import Disassembly, disassemble
+from mythril_tpu.disasm.asm import easm_to_code, instrs_to_easm, strip_metadata
+from mythril_tpu.support import opcodes
+
+
+def test_opcode_table_sanity():
+    assert opcodes.BY_NAME["PUSH32"].byte == 0x7F
+    assert opcodes.BY_NAME["DUP1"].byte == 0x80
+    assert opcodes.BY_NAME["SWAP16"].byte == 0x9F
+    assert opcodes.BY_NAME["SELFDESTRUCT"].pops == 1
+    assert opcodes.BY_NAME["CALL"].pops == 7 and opcodes.BY_NAME["CALL"].pushes == 1
+    assert opcodes.push_width("PUSH0") == 0
+    assert opcodes.push_width("PUSH17") == 17
+
+
+def test_roundtrip_simple():
+    code = bytes.fromhex("6001600201")  # PUSH1 1 PUSH1 2 ADD
+    instrs = disassemble(code)
+    assert [i.opcode for i in instrs] == ["PUSH1", "PUSH1", "ADD"]
+    assert instrs[1].argument_int == 2
+    assert easm_to_code(instrs_to_easm(instrs)) == code
+
+
+def test_truncated_push_padded():
+    instrs = disassemble(bytes.fromhex("61ff"))  # PUSH2 with 1 operand byte
+    assert instrs[0].opcode == "PUSH2"
+    assert instrs[0].argument == b"\xff\x00"
+
+
+def test_jumpdest_index():
+    code = easm_to_code("""
+        PUSH1 0x04
+        JUMP
+        STOP
+        JUMPDEST
+        STOP
+    """)
+    dis = Disassembly(code)
+    assert 4 in dis.valid_jump_destinations
+    assert dis.instruction_at(4).opcode == "JUMPDEST"
+    assert dis.instruction_at(0).opcode == "PUSH1"
+
+
+def test_function_entry_discovery():
+    # classic solc dispatcher ladder:
+    #   DUP1 PUSH4 <sel> EQ PUSH2 <target> JUMPI
+    easm = """
+        PUSH1 0x00
+        CALLDATALOAD
+        PUSH1 0xe0
+        SHR
+        DUP1
+        PUSH4 0x41c0e1b5
+        EQ
+        PUSH2 0x0020
+        JUMPI
+        STOP
+    """
+    dis = Disassembly(easm_to_code(easm))
+    assert dis.function_entries == {"41c0e1b5": 0x20}
+
+
+def test_strip_metadata():
+    runtime = bytes.fromhex("6001600101")
+    cbor = bytes.fromhex("a264697066735822") + b"\x00" * 40  # 0xa2 'ipfs' map
+    trailer = cbor + len(cbor).to_bytes(2, "big")
+    assert strip_metadata(runtime + trailer) == runtime
+    assert strip_metadata(runtime) == runtime
+
+
+def test_hex_string_input():
+    dis = Disassembly("0x6001600101")
+    assert len(dis.bytecode) == 5

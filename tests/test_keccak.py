@@ -1,0 +1,39 @@
+from mythril_tpu.utils.keccak import keccak256, keccak256_int, function_selector
+
+
+def test_empty_digest():
+    # the canonical Ethereum empty-code hash
+    assert keccak256(b"").hex() == (
+        "c5d2460186f7233c927e7db2dcc703c0e500b653ca82273b7bfad8045d85a470"
+    )
+
+
+def test_abc_digest():
+    assert keccak256(b"abc").hex() == (
+        "4e03657aea45a94fc7d47ba826c8d667c0d1e6e33a64a036ec44f58fa12d6c45"
+    )
+
+
+def test_known_selectors():
+    assert function_selector("transfer(address,uint256)").hex() == "a9059cbb"
+    assert function_selector("balanceOf(address)").hex() == "70a08231"
+    assert function_selector("kill()").hex() == "41c0e1b5"
+
+
+def test_multiblock_absorb():
+    # > one rate block (136 bytes); exercises the absorb loop
+    digest_a = keccak256(b"q" * 200)
+    digest_b = keccak256(b"q" * 200)
+    assert digest_a == digest_b and len(digest_a) == 32
+    assert digest_a != keccak256(b"q" * 201)
+
+
+def test_pad_edge_cases():
+    # 135 bytes leaves exactly one pad byte (0x81 case)
+    for n in (134, 135, 136, 137):
+        assert len(keccak256(b"z" * n)) == 32
+
+
+def test_int_hashing():
+    # mapping-slot math: keccak(key . slot) as used by solidity mappings
+    assert keccak256_int(0) == int.from_bytes(keccak256(b"\x00" * 32), "big")
