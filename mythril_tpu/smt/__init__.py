@@ -1,0 +1,84 @@
+"""Self-contained SMT layer (QF_ABV + uninterpreted functions).
+
+The environment ships no z3, so this package IS the solver stack:
+
+- terms.py     — immutable expression DAG with eager constant folding
+- bitvec.py    — user-facing BitVec API (operator overloads + annotations)
+- bool_expr.py — Bool API (And/Or/Not/...)
+- array_expr.py— functional arrays (Store/Select/K)
+- function.py  — uninterpreted functions
+- bitblast.py  — QF_BV -> AIG -> CNF lowering
+- solver/      — CDCL SAT (C++ with Python fallback), word-level frontend,
+                 model extraction, Optimize via bitwise binary search
+- tpu/         — batched clause tensors + JAX/Pallas device solver
+
+Parity surface mirrors reference mythril/laser/smt/__init__.py:153
+(symbol_factory, BitVec/Bool/Array/K/Function, Solver/Optimize, simplify,
+And/Or/Not/If/Concat/Extract/UDiv/URem/SRem/LShR/UGT/ULT/UGE/ULE/Sum,
+BVAddNoOverflow/BVMulNoOverflow/BVSubNoUnderflow, is_true/is_false).
+"""
+
+from mythril_tpu.smt.bitvec import (  # noqa: F401
+    BitVec,
+    BVAddNoOverflow,
+    BVMulNoOverflow,
+    BVSubNoUnderflow,
+    Concat,
+    Extract,
+    If,
+    LShR,
+    SDiv,
+    SRem,
+    Sum,
+    UDiv,
+    UGE,
+    UGT,
+    ULE,
+    ULT,
+    URem,
+    ZeroExt,
+    SignExt,
+)
+from mythril_tpu.smt.bool_expr import (  # noqa: F401
+    And,
+    Bool,
+    Implies,
+    Not,
+    Or,
+    Xor,
+    is_false,
+    is_true,
+)
+from mythril_tpu.smt.array_expr import Array, K  # noqa: F401
+from mythril_tpu.smt.function import Function  # noqa: F401
+from mythril_tpu.smt.model import Model  # noqa: F401
+from mythril_tpu.smt.terms import simplify_expr as _simplify_term  # noqa: F401
+
+
+def simplify(expression):
+    """Structural simplification; preserves the wrapper type + annotations."""
+    return expression.simplified()
+
+
+class _SymbolFactory:
+    """Single creation point for symbols/values — the designed backend seam
+    (reference laser/smt/__init__.py:36-153)."""
+
+    @staticmethod
+    def Bool(value: bool, annotations=None):
+        return Bool.value(value, annotations)
+
+    @staticmethod
+    def BoolSym(name: str, annotations=None):
+        return Bool.symbol(name, annotations)
+
+    @staticmethod
+    def BitVecVal(value: int, size: int, annotations=None):
+        return BitVec.value(value, size, annotations)
+
+    @staticmethod
+    def BitVecSym(name: str, size: int, annotations=None):
+        return BitVec.symbol(name, size, annotations)
+
+
+symbol_factory = _SymbolFactory()

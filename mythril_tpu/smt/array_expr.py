@@ -1,0 +1,57 @@
+"""Functional arrays (reference mythril/laser/smt/array.py surface).
+
+`Array("Storage_...", 256, 256)` — free symbolic array;
+`K(256, 256, 0)` — constant array. Index read returns a BitVec; item
+assignment rebinds the wrapper to a Store chain (matching the reference's
+mutate-in-place usage for storage/balances)."""
+
+from mythril_tpu.smt import terms
+from mythril_tpu.smt.bitvec import BitVec, Expression, _union, coerce
+
+
+class BaseArray(Expression):
+    __slots__ = ()
+
+    @property
+    def domain(self) -> int:
+        return self.raw.sort[1]
+
+    @property
+    def range(self) -> int:
+        return self.raw.sort[2]
+
+    def __getitem__(self, index) -> BitVec:
+        index = coerce(index, self.domain)
+        return BitVec(
+            terms.select(self.raw, index.raw),
+            _union(self.annotations, index.annotations),
+        )
+
+    def __setitem__(self, index, value) -> None:
+        index = coerce(index, self.domain)
+        value = coerce(value, self.range)
+        self.raw = terms.store(self.raw, index.raw, value.raw)
+        self.annotations = _union(
+            self.annotations, index.annotations, value.annotations
+        )
+
+    def clone(self) -> "BaseArray":
+        dup = type(self).__new__(type(self))
+        dup.raw = self.raw
+        dup.annotations = set(self.annotations)
+        return dup
+
+
+class Array(BaseArray):
+    __slots__ = ()
+
+    def __init__(self, name: str, domain: int = 256, range_: int = 256):
+        super().__init__(terms.array_sym(name, domain, range_))
+
+
+class K(BaseArray):
+    __slots__ = ()
+
+    def __init__(self, domain: int = 256, range_: int = 256, value: int = 0):
+        value_term = terms.bv_val(value, range_)
+        super().__init__(terms.const_array(domain, value_term))

@@ -1,0 +1,380 @@
+"""QF_BV -> AIG -> CNF lowering.
+
+The seam between the word-level term DAG and both SAT backends (C++ CDCL on
+host, batched clause tensors on TPU). Terms reaching this layer must be pure
+QF_BV — arrays and UFs are eliminated by the solver frontend first
+(ackermannization + read-over-write unwinding, see solver/frontend.py).
+
+Literal encoding (standard AIG): variable v -> literals 2v (pos) / 2v+1
+(neg); constants FALSE=0, TRUE=1. AND gates are structurally hashed.
+Bit vectors are LSB-first literal lists. CNF via Tseitin (3 clauses/gate).
+"""
+
+from typing import Dict, List, Tuple
+
+from mythril_tpu.smt.terms import BOOL, Term
+
+FALSE_LIT = 0
+TRUE_LIT = 1
+
+
+class AIG:
+    """And-Inverter Graph with structural hashing."""
+
+    def __init__(self):
+        self.num_vars = 0          # var 0 reserved for constant TRUE/FALSE
+        self.gates: List[Tuple[int, int]] = []  # gate i -> (lhs_lit, rhs_lit); output var = gate_var[i]
+        self.gate_vars: List[int] = []
+        self._strash: Dict[Tuple[int, int], int] = {}
+
+    def new_var(self) -> int:
+        self.num_vars += 1
+        return self.num_vars
+
+    def lit_of_var(self, var: int, negated: bool = False) -> int:
+        return 2 * var + (1 if negated else 0)
+
+    def and_gate(self, a: int, b: int) -> int:
+        if a > b:
+            a, b = b, a
+        if a == FALSE_LIT:
+            return FALSE_LIT
+        if a == TRUE_LIT:
+            return b
+        if a == b:
+            return a
+        if a ^ 1 == b:
+            return FALSE_LIT
+        key = (a, b)
+        hit = self._strash.get(key)
+        if hit is not None:
+            return hit
+        var = self.new_var()
+        self.gates.append((a, b))
+        self.gate_vars.append(var)
+        lit = 2 * var
+        self._strash[key] = lit
+        return lit
+
+    def or_gate(self, a: int, b: int) -> int:
+        return self.and_gate(a ^ 1, b ^ 1) ^ 1
+
+    def xor_gate(self, a: int, b: int) -> int:
+        return self.or_gate(self.and_gate(a, b ^ 1), self.and_gate(a ^ 1, b))
+
+    def xnor_gate(self, a: int, b: int) -> int:
+        return self.xor_gate(a, b) ^ 1
+
+    def mux(self, sel: int, then: int, otherwise: int) -> int:
+        return self.or_gate(self.and_gate(sel, then), self.and_gate(sel ^ 1, otherwise))
+
+    def to_cnf(self, roots: List[int], defined: List[int] = ()):
+        """Tseitin-encode gates reachable from `roots` + `defined`.
+
+        `roots` are asserted true; `defined` literals only get their defining
+        gate clauses emitted (used by Optimize to constrain objective bits
+        via SAT assumptions without asserting them).
+        Returns (num_vars, clauses) with DIMACS-style signed literal ints.
+        """
+        clauses: List[Tuple[int, ...]] = []
+
+        def dimacs(lit: int) -> int:
+            var = lit >> 1
+            return -var if lit & 1 else var
+
+        # find reachable gates
+        needed = set()
+        stack = [r >> 1 for r in list(roots) + list(defined) if r >> 1 != 0]
+        gate_index = {v: i for i, v in enumerate(self.gate_vars)}
+        while stack:
+            var = stack.pop()
+            if var in needed:
+                continue
+            needed.add(var)
+            gi = gate_index.get(var)
+            if gi is not None:
+                lhs, rhs = self.gates[gi]
+                for lit in (lhs, rhs):
+                    if lit >> 1 != 0:
+                        stack.append(lit >> 1)
+
+        for gi, var in enumerate(self.gate_vars):
+            if var not in needed:
+                continue
+            lhs, rhs = self.gates[gi]
+            g, a, b = var, dimacs(lhs), dimacs(rhs)
+            clauses.append((-g, a))
+            clauses.append((-g, b))
+            clauses.append((g, -a, -b))
+
+        for root in roots:
+            if root == FALSE_LIT:
+                clauses.append(())  # empty clause: trivially unsat
+            elif root == TRUE_LIT:
+                continue
+            else:
+                clauses.append((dimacs(root),))
+        return self.num_vars, clauses
+
+
+class Blaster:
+    """Memoized lowering of a term DAG into one shared AIG."""
+
+    def __init__(self):
+        self.aig = AIG()
+        self._bv_cache: Dict[int, List[int]] = {}
+        self._bool_cache: Dict[int, int] = {}
+        # symbol name -> list of var ids (LSB first) for model extraction
+        self.bv_symbol_vars: Dict[str, List[int]] = {}
+        self.bool_symbol_vars: Dict[str, int] = {}
+
+    # -- public -------------------------------------------------------------
+
+    def assert_bool(self, term: Term) -> int:
+        return self._bool(term)
+
+    def bv_bits(self, term: Term) -> List[int]:
+        """AIG literals (LSB-first) of a bitvector term; grows the AIG."""
+        return self._bv(term)
+
+    def cnf(self, assertion_terms: List[Term], defined_lits: List[int] = ()):
+        roots = [self._bool(t) for t in assertion_terms]
+        return self.aig.to_cnf(roots, defined_lits)
+
+    # -- bool lowering ------------------------------------------------------
+
+    def _bool(self, term: Term) -> int:
+        assert term.sort == BOOL, f"not a bool: {term!r}"
+        hit = self._bool_cache.get(id(term))
+        if hit is not None:
+            return hit
+        lit = self._bool_compute(term)
+        self._bool_cache[id(term)] = lit
+        return lit
+
+    def _bool_compute(self, term: Term) -> int:
+        aig = self.aig
+        op = term.op
+        if op == "true":
+            return TRUE_LIT
+        if op == "false":
+            return FALSE_LIT
+        if op == "sym":
+            name = term.params[0]
+            var = self.bool_symbol_vars.get(name)
+            if var is None:
+                var = aig.new_var()
+                self.bool_symbol_vars[name] = var
+            return 2 * var
+        if op == "not":
+            return self._bool(term.children[0]) ^ 1
+        if op == "and":
+            acc = TRUE_LIT
+            for child in term.children:
+                acc = aig.and_gate(acc, self._bool(child))
+            return acc
+        if op == "or":
+            acc = FALSE_LIT
+            for child in term.children:
+                acc = aig.or_gate(acc, self._bool(child))
+            return acc
+        if op == "xor":
+            return aig.xor_gate(self._bool(term.children[0]), self._bool(term.children[1]))
+        if op == "ite":
+            return aig.mux(
+                self._bool(term.children[0]),
+                self._bool(term.children[1]),
+                self._bool(term.children[2]),
+            )
+        if op == "eq":
+            a, b = term.children
+            if a.sort == BOOL:
+                return aig.xnor_gate(self._bool(a), self._bool(b))
+            return self._eq_bits(self._bv(a), self._bv(b))
+        if op in ("bvult", "bvule", "bvslt", "bvsle"):
+            return self._compare(op, term.children[0], term.children[1])
+        raise NotImplementedError(f"bool lowering: {op}")
+
+    def _eq_bits(self, xs: List[int], ys: List[int]) -> int:
+        acc = TRUE_LIT
+        for x, y in zip(xs, ys):
+            acc = self.aig.and_gate(acc, self.aig.xnor_gate(x, y))
+        return acc
+
+    def _compare(self, op: str, a: Term, b: Term) -> int:
+        xs, ys = self._bv(a), self._bv(b)
+        if op in ("bvult", "bvule"):
+            lt = self._ult(xs, ys)
+            if op == "bvult":
+                return lt
+            return self.aig.or_gate(lt, self._eq_bits(xs, ys))
+        # signed: flip sign bits then unsigned compare
+        xs2 = xs[:-1] + [xs[-1] ^ 1]
+        ys2 = ys[:-1] + [ys[-1] ^ 1]
+        lt = self._ult(xs2, ys2)
+        if op == "bvslt":
+            return lt
+        return self.aig.or_gate(lt, self._eq_bits(xs, ys))
+
+    def _ult(self, xs: List[int], ys: List[int]) -> int:
+        """Unsigned less-than via borrow chain, LSB->MSB."""
+        aig = self.aig
+        lt = FALSE_LIT
+        for x, y in zip(xs, ys):
+            x_eq_y = aig.xnor_gate(x, y)
+            x_lt_y = aig.and_gate(x ^ 1, y)
+            lt = aig.or_gate(x_lt_y, aig.and_gate(x_eq_y, lt))
+        return lt
+
+    # -- bitvector lowering -------------------------------------------------
+
+    def _bv(self, term: Term) -> List[int]:
+        hit = self._bv_cache.get(id(term))
+        if hit is not None:
+            return hit
+        bits = self._bv_compute(term)
+        assert len(bits) == term.size, f"{term.op}: {len(bits)} != {term.size}"
+        self._bv_cache[id(term)] = bits
+        return bits
+
+    def _bv_compute(self, term: Term) -> List[int]:
+        aig = self.aig
+        op = term.op
+        size = term.size
+        if op == "const":
+            return [TRUE_LIT if (term.value >> i) & 1 else FALSE_LIT for i in range(size)]
+        if op == "sym":
+            name = term.params[0]
+            cached = self.bv_symbol_vars.get(name)
+            if cached is None:
+                cached = [aig.new_var() for _ in range(size)]
+                self.bv_symbol_vars[name] = cached
+            return [2 * v for v in cached]
+        child_bits = [self._bv(c) for c in term.children if isinstance(c.sort, int)]
+        if op == "bvand":
+            return [aig.and_gate(x, y) for x, y in zip(*child_bits)]
+        if op == "bvor":
+            return [aig.or_gate(x, y) for x, y in zip(*child_bits)]
+        if op == "bvxor":
+            return [aig.xor_gate(x, y) for x, y in zip(*child_bits)]
+        if op == "bvnot":
+            return [x ^ 1 for x in child_bits[0]]
+        if op == "bvneg":
+            return self._add(
+                [x ^ 1 for x in child_bits[0]],
+                [TRUE_LIT] + [FALSE_LIT] * (size - 1),
+            )
+        if op == "bvadd":
+            return self._add(child_bits[0], child_bits[1])
+        if op == "bvsub":
+            return self._add(child_bits[0], [y ^ 1 for y in child_bits[1]], carry_in=TRUE_LIT)
+        if op == "bvmul":
+            return self._mul(child_bits[0], child_bits[1])
+        if op in ("bvudiv", "bvurem"):
+            quotient, remainder = self._udivrem(child_bits[0], child_bits[1])
+            return quotient if op == "bvudiv" else remainder
+        if op in ("bvsdiv", "bvsrem"):
+            return self._sdivrem(op, child_bits[0], child_bits[1])
+        if op in ("bvshl", "bvlshr", "bvashr"):
+            return self._shift(op, child_bits[0], child_bits[1])
+        if op == "concat":
+            out: List[int] = []
+            for c, bits in zip(reversed(term.children), reversed(child_bits)):
+                out.extend(bits)
+            return out
+        if op == "extract":
+            hi, lo = term.params
+            return child_bits[0][lo : hi + 1]
+        if op == "zext":
+            return child_bits[0] + [FALSE_LIT] * term.params[0]
+        if op == "sext":
+            return child_bits[0] + [child_bits[0][-1]] * term.params[0]
+        if op == "ite":
+            sel = self._bool(term.children[0])
+            then_bits = self._bv(term.children[1])
+            else_bits = self._bv(term.children[2])
+            return [aig.mux(sel, t, e) for t, e in zip(then_bits, else_bits)]
+        raise NotImplementedError(f"bv lowering: {op}")
+
+    def _add(self, xs: List[int], ys: List[int], carry_in: int = FALSE_LIT) -> List[int]:
+        aig = self.aig
+        out = []
+        carry = carry_in
+        for x, y in zip(xs, ys):
+            x_xor_y = aig.xor_gate(x, y)
+            out.append(aig.xor_gate(x_xor_y, carry))
+            carry = aig.or_gate(aig.and_gate(x, y), aig.and_gate(carry, x_xor_y))
+        return out
+
+    def _mul(self, xs: List[int], ys: List[int]) -> List[int]:
+        """Shift-and-add; constant zero partial products vanish via folding."""
+        aig = self.aig
+        size = len(xs)
+        acc = [FALSE_LIT] * size
+        for i, y in enumerate(ys):
+            if y == FALSE_LIT:
+                continue
+            partial = [FALSE_LIT] * i + [aig.and_gate(x, y) for x in xs[: size - i]]
+            acc = self._add(acc, partial)
+        return acc
+
+    def _udivrem(self, xs: List[int], ys: List[int]) -> Tuple[List[int], List[int]]:
+        """Restoring division MSB-first; EVM convention: x/0 = 0, x%0 = 0."""
+        aig = self.aig
+        size = len(xs)
+        remainder = [FALSE_LIT] * size
+        quotient = [FALSE_LIT] * size
+        for i in range(size - 1, -1, -1):
+            remainder = [xs[i]] + remainder[:-1]  # shift left, bring down bit i
+            geq = self._ult(remainder, ys) ^ 1   # remainder >= divisor
+            diff = self._add(remainder, [y ^ 1 for y in ys], carry_in=TRUE_LIT)
+            remainder = [aig.mux(geq, d, r) for d, r in zip(diff, remainder)]
+            quotient[i] = geq
+        # EVM convention: x/0 = 0 and x%0 = 0
+        zero = self._eq_bits(ys, [FALSE_LIT] * size)
+        quotient = [aig.and_gate(q, zero ^ 1) for q in quotient]
+        remainder = [aig.and_gate(r, zero ^ 1) for r in remainder]
+        return quotient, remainder
+
+    def _sdivrem(self, op: str, xs: List[int], ys: List[int]) -> List[int]:
+        aig = self.aig
+        size = len(xs)
+        sign_x, sign_y = xs[-1], ys[-1]
+        abs_x = self._abs(xs)
+        abs_y = self._abs(ys)
+        quotient, remainder = self._udivrem(abs_x, abs_y)
+        if op == "bvsdiv":
+            neg = aig.xor_gate(sign_x, sign_y)
+            result = quotient
+        else:  # bvsrem takes the sign of the dividend
+            neg = sign_x
+            result = remainder
+        negated = self._add([r ^ 1 for r in result], [TRUE_LIT] + [FALSE_LIT] * (size - 1))
+        return [aig.mux(neg, n, r) for n, r in zip(negated, result)]
+
+    def _abs(self, xs: List[int]) -> List[int]:
+        aig = self.aig
+        size = len(xs)
+        sign = xs[-1]
+        negated = self._add([x ^ 1 for x in xs], [TRUE_LIT] + [FALSE_LIT] * (size - 1))
+        return [aig.mux(sign, n, x) for n, x in zip(negated, xs)]
+
+    def _shift(self, op: str, xs: List[int], ys: List[int]) -> List[int]:
+        """Barrel shifter; shift amounts >= size give 0 (or sign for ashr)."""
+        aig = self.aig
+        size = len(xs)
+        stages = max(1, (size - 1).bit_length())
+        fill = xs[-1] if op == "bvashr" else FALSE_LIT
+        bits = list(xs)
+        for stage in range(stages):
+            amount = 1 << stage
+            sel = ys[stage] if stage < len(ys) else FALSE_LIT
+            if op == "bvshl":
+                shifted = [fill] * min(amount, size) + bits[: max(size - amount, 0)]
+            else:
+                shifted = bits[amount:] + [fill] * min(amount, size)
+            bits = [aig.mux(sel, s, b) for s, b in zip(shifted, bits)]
+        overshoot = FALSE_LIT
+        for extra_bit in ys[stages:]:
+            overshoot = aig.or_gate(overshoot, extra_bit)
+        return [aig.mux(overshoot, fill, b) for b in bits]

@@ -1,0 +1,319 @@
+"""User-facing BitVec API over the term DAG.
+
+Mirrors the reference surface (mythril/laser/smt/bitvec.py +
+bitvec_helper.py): operator overloading with annotation propagation —
+annotations are the taint channel every detection module relies on.
+
+Operator conventions (chosen for EVM semantics, documented divergence from
+z3py defaults): `/` and `%` are UNSIGNED (EVM DIV/MOD); `<`, `>`, `<=`, `>=`
+are UNSIGNED comparisons (EVM LT/GT). Signed variants are explicit: SDiv,
+SRem, `a.slt(b)`, `a.sgt(b)`.
+"""
+
+from typing import Iterable, Optional, Set
+
+from mythril_tpu.smt import terms
+from mythril_tpu.smt.terms import Term
+
+
+def _union(*annotation_sets):
+    out: Set = set()
+    for s in annotation_sets:
+        if s:
+            out |= s
+    return out
+
+
+class Expression:
+    __slots__ = ("raw", "annotations")
+
+    def __init__(self, raw: Term, annotations: Optional[Iterable] = None):
+        self.raw = raw
+        self.annotations = set(annotations) if annotations else set()
+
+    def annotate(self, annotation):
+        self.annotations.add(annotation)
+
+    def get_annotations(self, annotation_type):
+        return [a for a in self.annotations if isinstance(a, annotation_type)]
+
+    def __hash__(self):
+        return hash(self.raw)
+
+    def simplified(self):
+        return type(self)(terms.simplify_expr(self.raw), self.annotations)
+
+
+class BitVec(Expression):
+    __slots__ = ()
+
+    @classmethod
+    def value(cls, value: int, size: int, annotations=None) -> "BitVec":
+        return cls(terms.bv_val(value, size), annotations)
+
+    @classmethod
+    def symbol(cls, name: str, size: int, annotations=None) -> "BitVec":
+        return cls(terms.bv_sym(name, size), annotations)
+
+    @property
+    def size(self) -> int:
+        return self.raw.size
+
+    @property
+    def symbolic(self) -> bool:
+        return not self.raw.is_const
+
+    def __repr__(self):
+        return f"BitVec({self.raw!r})"
+
+    @property
+    def concrete_value(self) -> int:
+        """The constant value; raises if symbolic."""
+        if not self.raw.is_const:
+            raise ValueError(f"not concrete: {self.raw!r}")
+        return self.raw.value
+
+    # -- arithmetic ---------------------------------------------------------
+    def _bin(self, op, other) -> "BitVec":
+        other = coerce(other, self.size)
+        return BitVec(
+            terms.bv_binop(op, self.raw, other.raw),
+            _union(self.annotations, other.annotations),
+        )
+
+    def __add__(self, other):
+        return self._bin("bvadd", other)
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return self._bin("bvsub", other)
+
+    def __rsub__(self, other):
+        return coerce(other, self.size)._bin("bvsub", self)
+
+    def __mul__(self, other):
+        return self._bin("bvmul", other)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):  # unsigned (EVM DIV)
+        return self._bin("bvudiv", other)
+
+    def __mod__(self, other):  # unsigned (EVM MOD)
+        return self._bin("bvurem", other)
+
+    def __and__(self, other):
+        return self._bin("bvand", other)
+
+    __rand__ = __and__
+
+    def __or__(self, other):
+        return self._bin("bvor", other)
+
+    __ror__ = __or__
+
+    def __xor__(self, other):
+        return self._bin("bvxor", other)
+
+    __rxor__ = __xor__
+
+    def __lshift__(self, other):
+        return self._bin("bvshl", other)
+
+    def __rshift__(self, other):  # logical (EVM SHR); AShR explicit
+        return self._bin("bvlshr", other)
+
+    def __invert__(self):
+        return BitVec(terms.bv_not(self.raw), set(self.annotations))
+
+    def __neg__(self):
+        return BitVec(terms.bv_neg(self.raw), set(self.annotations))
+
+    # -- comparisons (unsigned by default; EVM LT/GT) -----------------------
+    def _cmp(self, op, other) -> "Bool":
+        from mythril_tpu.smt.bool_expr import Bool
+
+        other = coerce(other, self.size)
+        return Bool(
+            terms.bv_cmp(op, self.raw, other.raw),
+            _union(self.annotations, other.annotations),
+        )
+
+    def __lt__(self, other):
+        return self._cmp("bvult", other)
+
+    def __le__(self, other):
+        return self._cmp("bvule", other)
+
+    def __gt__(self, other):
+        other = coerce(other, self.size)
+        return other._cmp("bvult", self)
+
+    def __ge__(self, other):
+        other = coerce(other, self.size)
+        return other._cmp("bvule", self)
+
+    def __eq__(self, other):  # type: ignore[override]
+        from mythril_tpu.smt.bool_expr import Bool
+
+        other = coerce(other, self.size)
+        return Bool(
+            terms.eq(self.raw, other.raw),
+            _union(self.annotations, other.annotations),
+        )
+
+    def __ne__(self, other):  # type: ignore[override]
+        from mythril_tpu.smt.bool_expr import Bool
+
+        other = coerce(other, self.size)
+        return Bool(
+            terms.bool_not(terms.eq(self.raw, other.raw)),
+            _union(self.annotations, other.annotations),
+        )
+
+    def slt(self, other) -> "Bool":
+        return self._cmp("bvslt", other)
+
+    def sle(self, other) -> "Bool":
+        return self._cmp("bvsle", other)
+
+    def sgt(self, other) -> "Bool":
+        other = coerce(other, self.size)
+        return other._cmp("bvslt", self)
+
+    def sge(self, other) -> "Bool":
+        other = coerce(other, self.size)
+        return other._cmp("bvsle", self)
+
+
+def coerce(value, size: int) -> BitVec:
+    if isinstance(value, BitVec):
+        return value
+    if isinstance(value, int):
+        return BitVec.value(value, size)
+    raise TypeError(f"cannot coerce {type(value)!r} to BitVec")
+
+
+# ---------------------------------------------------------------------------
+# helper constructors (reference bitvec_helper.py surface)
+
+
+def Concat(*args) -> BitVec:
+    parts = args[0] if len(args) == 1 and isinstance(args[0], list) else args
+    return BitVec(
+        terms.concat([p.raw for p in parts]), _union(*(p.annotations for p in parts))
+    )
+
+
+def Extract(high: int, low: int, value: BitVec) -> BitVec:
+    return BitVec(terms.extract(high, low, value.raw), set(value.annotations))
+
+
+def UDiv(a: BitVec, b) -> BitVec:
+    return a._bin("bvudiv", b)
+
+
+def URem(a: BitVec, b) -> BitVec:
+    return a._bin("bvurem", b)
+
+
+def SDiv(a: BitVec, b) -> BitVec:
+    return a._bin("bvsdiv", b)
+
+
+def SRem(a: BitVec, b) -> BitVec:
+    return a._bin("bvsrem", b)
+
+
+def LShR(a: BitVec, b) -> BitVec:
+    return a._bin("bvlshr", b)
+
+
+def AShR(a: BitVec, b) -> BitVec:
+    return a._bin("bvashr", b)
+
+
+def ULT(a: BitVec, b) -> "Bool":
+    return a._cmp("bvult", b)
+
+
+def ULE(a: BitVec, b) -> "Bool":
+    return a._cmp("bvule", b)
+
+
+def UGT(a: BitVec, b) -> "Bool":
+    return coerce(b, a.size)._cmp("bvult", a)
+
+
+def UGE(a: BitVec, b) -> "Bool":
+    return coerce(b, a.size)._cmp("bvule", a)
+
+
+def ZeroExt(extra: int, value: BitVec) -> BitVec:
+    return BitVec(terms.zext(extra, value.raw), set(value.annotations))
+
+
+def SignExt(extra: int, value: BitVec) -> BitVec:
+    return BitVec(terms.sext(extra, value.raw), set(value.annotations))
+
+
+def If(cond, then, otherwise):
+    """Polymorphic ite over BitVec/Bool wrappers (mixed ints coerced)."""
+    from mythril_tpu.smt.bool_expr import Bool
+
+    if isinstance(cond, bool):
+        cond = Bool.value(cond)
+    if isinstance(then, BitVec) or isinstance(otherwise, BitVec):
+        width = then.size if isinstance(then, BitVec) else otherwise.size
+        then = coerce(then, width)
+        otherwise = coerce(otherwise, width)
+        wrapper = BitVec
+    else:
+        if isinstance(then, bool):
+            then = Bool.value(then)
+        if isinstance(otherwise, bool):
+            otherwise = Bool.value(otherwise)
+        wrapper = Bool
+    return wrapper(
+        terms.ite(cond.raw, then.raw, otherwise.raw),
+        _union(cond.annotations, then.annotations, otherwise.annotations),
+    )
+
+
+def Sum(*args) -> BitVec:
+    total = args[0]
+    for a in args[1:]:
+        total = total + a
+    return total
+
+
+# -- overflow predicates (reference bitvec_helper.py; used by integer module)
+
+
+def BVAddNoOverflow(a: BitVec, b, signed: bool) -> "Bool":
+    b = coerce(b, a.size)
+    if signed:
+        wide_a, wide_b = SignExt(1, a), SignExt(1, b)
+        wide = wide_a + wide_b
+        return SignExt(1, Extract(a.size - 1, 0, wide)) == wide
+    wide = ZeroExt(1, a) + ZeroExt(1, b)
+    return Extract(a.size, a.size, wide) == BitVec.value(0, 1)
+
+
+def BVSubNoUnderflow(a: BitVec, b, signed: bool) -> "Bool":
+    b = coerce(b, a.size)
+    if signed:
+        wide = SignExt(1, a) - SignExt(1, b)
+        return SignExt(1, Extract(a.size - 1, 0, wide)) == wide
+    return UGE(a, b)
+
+
+def BVMulNoOverflow(a: BitVec, b, signed: bool) -> "Bool":
+    b = coerce(b, a.size)
+    size = a.size
+    if signed:
+        wide = SignExt(size, a) * SignExt(size, b)
+        return SignExt(size, Extract(size - 1, 0, wide)) == wide
+    wide = ZeroExt(size, a) * ZeroExt(size, b)
+    return Extract(2 * size - 1, size, wide) == BitVec.value(0, size)

@@ -1,0 +1,95 @@
+"""User-facing Bool API (reference mythril/laser/smt/bool.py surface)."""
+
+from typing import Optional
+
+from mythril_tpu.smt import terms
+from mythril_tpu.smt.bitvec import Expression, _union
+
+
+class Bool(Expression):
+    __slots__ = ()
+
+    @classmethod
+    def value(cls, value: bool, annotations=None) -> "Bool":
+        return cls(terms.bool_val(value), annotations)
+
+    @classmethod
+    def symbol(cls, name: str, annotations=None) -> "Bool":
+        return cls(terms.bool_sym(name), annotations)
+
+    @property
+    def is_false(self) -> bool:
+        return self.raw.is_const and self.raw.value is False
+
+    @property
+    def is_true(self) -> bool:
+        return self.raw.is_const and self.raw.value is True
+
+    @property
+    def symbolic(self) -> bool:
+        return not self.raw.is_const
+
+    def value_or_none(self) -> Optional[bool]:
+        return self.raw.value if self.raw.is_const else None
+
+    def __repr__(self):
+        return f"Bool({self.raw!r})"
+
+    def __eq__(self, other):  # type: ignore[override]
+        if isinstance(other, bool):
+            other = Bool.value(other)
+        return Bool(
+            terms.eq(self.raw, other.raw), _union(self.annotations, other.annotations)
+        )
+
+    def __ne__(self, other):  # type: ignore[override]
+        if isinstance(other, bool):
+            other = Bool.value(other)
+        return Bool(
+            terms.bool_not(terms.eq(self.raw, other.raw)),
+            _union(self.annotations, other.annotations),
+        )
+
+    def __hash__(self):
+        return hash(self.raw)
+
+
+def And(*args) -> Bool:
+    flat = args[0] if len(args) == 1 and isinstance(args[0], list) else args
+    flat = [Bool.value(a) if isinstance(a, bool) else a for a in flat]
+    return Bool(
+        terms.bool_and([a.raw for a in flat]),
+        _union(*(a.annotations for a in flat)),
+    )
+
+
+def Or(*args) -> Bool:
+    flat = args[0] if len(args) == 1 and isinstance(args[0], list) else args
+    flat = [Bool.value(a) if isinstance(a, bool) else a for a in flat]
+    return Bool(
+        terms.bool_or([a.raw for a in flat]),
+        _union(*(a.annotations for a in flat)),
+    )
+
+
+def Not(a: Bool) -> Bool:
+    return Bool(terms.bool_not(a.raw), set(a.annotations))
+
+
+def Xor(a: Bool, b: Bool) -> Bool:
+    return Bool(terms.bool_xor(a.raw, b.raw), _union(a.annotations, b.annotations))
+
+
+def Implies(a: Bool, b: Bool) -> Bool:
+    return Bool(
+        terms.bool_or([terms.bool_not(a.raw), b.raw]),
+        _union(a.annotations, b.annotations),
+    )
+
+
+def is_true(a: Bool) -> bool:
+    return a.raw.is_const and a.raw.value is True
+
+
+def is_false(a: Bool) -> bool:
+    return a.raw.is_const and a.raw.value is False

@@ -1,0 +1,115 @@
+"""Concrete evaluation of terms under an assignment.
+
+Used for: model validation after SAT, the quick-sat model cache (reference
+support/support_utils.py:57-68), and differential testing of the bit-blaster
+(circuit output vs this evaluator on random inputs).
+
+Assignment maps:
+  bv/bool symbol name -> int / bool
+  array name          -> (default_int, {index_int: value_int})
+  FuncDecl name       -> (default_int, {args_tuple: value_int})
+Missing entries evaluate to 0 / False / empty (model completion).
+"""
+
+from typing import Dict, Tuple
+
+from mythril_tpu.smt.terms import BOOL, Term, to_signed, walk_terms, _fold2
+
+
+class ArrayValue:
+    __slots__ = ("default", "entries")
+
+    def __init__(self, default: int, entries: Dict[int, int]):
+        self.default = default
+        self.entries = entries
+
+    def get(self, index: int) -> int:
+        return self.entries.get(index, self.default)
+
+
+def evaluate(term: Term, assignment: Dict) -> object:
+    """Returns int for bitvectors, bool for bools, ArrayValue for arrays."""
+    values: Dict[int, object] = {}
+    for node in walk_terms([term]):
+        values[id(node)] = _eval_node(node, values, assignment)
+    return values[id(term)]
+
+
+def evaluate_many(terms_list, assignment: Dict):
+    values: Dict[int, object] = {}
+    for node in walk_terms(terms_list):
+        values[id(node)] = _eval_node(node, values, assignment)
+    return [values[id(t)] for t in terms_list]
+
+
+def _eval_node(node: Term, values: Dict[int, object], assignment: Dict):
+    op = node.op
+    if node.is_const and op != "karray":
+        return node.value
+    child = [values[id(c)] for c in node.children]
+    if op == "sym":
+        default = False if node.sort == BOOL else 0
+        return assignment.get(node.params[0], default)
+    if op == "array":
+        raw = assignment.get(node.params[0], (0, {}))
+        return ArrayValue(raw[0], dict(raw[1]))
+    if op == "karray":
+        return ArrayValue(child[0], {})
+    if op == "store":
+        base: ArrayValue = child[0]
+        entries = dict(base.entries)
+        entries[child[1]] = child[2]
+        return ArrayValue(base.default, entries)
+    if op == "select":
+        return child[0].get(child[1])
+    if op == "apply":
+        decl = node.params[0]
+        raw = assignment.get(decl.name, (0, {}))
+        return raw[1].get(tuple(child), raw[0])
+    size = node.sort if isinstance(node.sort, int) else None
+    if op in ("bvadd", "bvsub", "bvmul", "bvudiv", "bvurem", "bvsdiv", "bvsrem",
+              "bvand", "bvor", "bvxor", "bvshl", "bvlshr", "bvashr"):
+        return _fold2(op, child[0], child[1], size)
+    if op == "bvnot":
+        return ~child[0] & ((1 << size) - 1)
+    if op == "bvneg":
+        return -child[0] & ((1 << size) - 1)
+    if op == "concat":
+        acc = 0
+        for c, v in zip(node.children, child):
+            acc = (acc << c.size) | v
+        return acc
+    if op == "extract":
+        hi, lo = node.params
+        return (child[0] >> lo) & ((1 << (hi - lo + 1)) - 1)
+    if op == "zext":
+        return child[0]
+    if op == "sext":
+        inner = node.children[0]
+        return to_signed(child[0], inner.size) & ((1 << node.sort) - 1)
+    if op == "eq":
+        a, b = child
+        if isinstance(a, ArrayValue) or isinstance(b, ArrayValue):
+            raise NotImplementedError("array extensionality not supported")
+        return a == b
+    if op == "bvult":
+        return child[0] < child[1]
+    if op == "bvule":
+        return child[0] <= child[1]
+    if op == "bvslt":
+        width = node.children[0].size
+        return to_signed(child[0], width) < to_signed(child[1], width)
+    if op == "bvsle":
+        width = node.children[0].size
+        return to_signed(child[0], width) <= to_signed(child[1], width)
+    if op == "and":
+        return all(child)
+    if op == "or":
+        return any(child)
+    if op == "not":
+        return not child[0]
+    if op == "xor":
+        return child[0] != child[1]
+    if op == "ite":
+        return child[1] if child[0] else child[2]
+    raise NotImplementedError(f"evaluate: {op}")

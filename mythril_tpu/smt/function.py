@@ -1,0 +1,31 @@
+"""Uninterpreted functions (reference mythril/laser/smt/function.py).
+
+Used by the keccak and exponent function managers: `keccak256_<n>` and its
+inverse are modeled as UFs whose axioms are injected at solve time."""
+
+from typing import List, Union
+
+from mythril_tpu.smt import terms
+from mythril_tpu.smt.bitvec import BitVec, _union
+
+
+class Function:
+    def __init__(self, name: str, domain: Union[int, List[int]], range_: int):
+        domain_tuple = (domain,) if isinstance(domain, int) else tuple(domain)
+        self.decl = terms.FuncDecl(name, domain_tuple, range_)
+
+    @property
+    def name(self) -> str:
+        return self.decl.name
+
+    def __call__(self, *args: BitVec) -> BitVec:
+        return BitVec(
+            terms.apply_func(self.decl, tuple(a.raw for a in args)),
+            _union(*(a.annotations for a in args)),
+        )
+
+    def __hash__(self):
+        return hash(self.decl)
+
+    def __eq__(self, other):
+        return isinstance(other, Function) and self.decl == other.decl

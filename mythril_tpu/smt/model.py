@@ -1,0 +1,54 @@
+"""Model objects returned by the solvers (reference laser/smt/model.py).
+
+A model is an assignment (see eval.py) plus `eval(expr, model_completion)`.
+Supports merging several sub-models (the independence solver concatenates
+per-bucket models, reference solver/independence_solver.py:123-144)."""
+
+from typing import Dict, List, Optional
+
+from mythril_tpu.smt import terms
+from mythril_tpu.smt.bitvec import BitVec, Expression
+from mythril_tpu.smt.eval import evaluate
+
+
+class Model:
+    def __init__(self, assignment: Optional[Dict] = None, sub_models: Optional[List["Model"]] = None):
+        self.assignment: Dict = dict(assignment or {})
+        for sub in sub_models or []:
+            self.assignment.update(sub.assignment)
+
+    def decls(self):
+        return list(self.assignment)
+
+    def __bool__(self):
+        return True
+
+    def eval(self, expression, model_completion: bool = True):
+        """Evaluate a wrapper or raw term to a concrete BitVec/bool."""
+        raw = expression.raw if isinstance(expression, Expression) else expression
+        result = evaluate(raw, self.assignment)
+        if isinstance(raw.sort, int):
+            return BitVec.value(result, raw.sort)
+        return result
+
+    def eval_int(self, expression, default: int = 0) -> int:
+        raw = expression.raw if isinstance(expression, Expression) else expression
+        result = evaluate(raw, self.assignment)
+        if isinstance(result, bool):
+            return int(result)
+        return result
+
+    def satisfies(self, constraints) -> bool:
+        """Check this model against a constraint list (quick-sat probe)."""
+        try:
+            for constraint in constraints:
+                raw = constraint.raw if isinstance(constraint, Expression) else constraint
+                if evaluate(raw, self.assignment) is not True:
+                    return False
+            return True
+        except NotImplementedError:
+            return False
+
+    def __repr__(self):
+        items = ", ".join(f"{k}={v}" for k, v in list(self.assignment.items())[:8])
+        return f"Model({items}{'…' if len(self.assignment) > 8 else ''})"

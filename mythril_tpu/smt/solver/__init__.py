@@ -1,0 +1,9 @@
+"""Solver backends: word-level frontend, CPU CDCL (C++), TPU batched solver."""
+
+from mythril_tpu.smt.solver.frontend import (  # noqa: F401
+    Optimize,
+    Solver,
+    UnsatError,
+    SolverTimeOutException,
+)
+from mythril_tpu.smt.solver.statistics import SolverStatistics  # noqa: F401

@@ -1,0 +1,390 @@
+"""Word-level solver frontend.
+
+check() pipeline:
+  1. simplify + trivial verdicts
+  2. lower to pure QF_BV:
+     - unwind select-over-store chains into ite ladders (read-over-write)
+     - ackermannize remaining selects on base arrays (fresh symbol per
+       distinct index term + pairwise congruence axioms)
+     - ackermannize uninterpreted-function applications the same way
+  3. bit-blast to CNF (smt/bitblast.py)
+  4. CDCL SAT (solver/sat_backend.py — C++ with Python fallback)
+  5. reconstruct a word-level model (incl. array/UF tables) and VALIDATE it
+     against the original constraints with the independent evaluator —
+     the soundness net replacing the absent z3 oracle.
+
+Optimize implements minimize/maximize by MSB-first bit fixing under
+assumptions over the objective's CNF bits (role of z3.Optimize in
+reference analysis/solver.py:217-257 exploit minimization).
+"""
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+from mythril_tpu.smt import terms
+from mythril_tpu.smt.bitblast import Blaster
+from mythril_tpu.smt.bitvec import Expression
+from mythril_tpu.smt.eval import evaluate
+from mythril_tpu.smt.model import Model
+from mythril_tpu.smt.solver import sat_backend
+from mythril_tpu.smt.solver.statistics import SolverStatistics
+from mythril_tpu.smt.terms import BOOL, Term
+
+
+class UnsatError(Exception):
+    pass
+
+
+class SolverTimeOutException(Exception):
+    pass
+
+
+class SolverInternalError(Exception):
+    """A produced model failed validation — a bug in the solver stack."""
+
+
+SAT, UNSAT, UNKNOWN = sat_backend.SAT, sat_backend.UNSAT, sat_backend.UNKNOWN
+
+
+def _raw(constraint) -> Term:
+    return constraint.raw if isinstance(constraint, Expression) else constraint
+
+
+class _Lowering:
+    """Rewrites a set of bool terms into pure QF_BV + side constraints."""
+
+    def __init__(self):
+        self.cache: Dict[int, Term] = {}
+        self.side_constraints: List[Term] = []
+        # (array_name) -> list of (index_term, fresh_sym_term)
+        self.array_reads: Dict[str, List[Tuple[Term, Term]]] = {}
+        # func name -> list of (args_tuple, fresh_sym_term)
+        self.func_apps: Dict[str, List[Tuple[Tuple[Term, ...], Term]]] = {}
+        self._fresh = 0
+
+    def fresh(self, size: int, tag: str) -> Term:
+        self._fresh += 1
+        return terms.bv_sym(f"!{tag}!{self._fresh}", size)
+
+    def lower(self, term: Term) -> Term:
+        hit = self.cache.get(id(term))
+        if hit is not None:
+            return hit
+        result = self._lower_node(term)
+        self.cache[id(term)] = result
+        return result
+
+    def drain_side_constraints(self) -> List[Term]:
+        out = self.side_constraints
+        self.side_constraints = []
+        return out
+
+    def _lower_node(self, term: Term) -> Term:
+        op = term.op
+        if op == "select":
+            return self._lower_select(term.children[0], self.lower_index(term.children[1]))
+        if op == "apply":
+            decl = term.params[0]
+            args = tuple(self.lower(a) for a in term.children)
+            return self._ackermann_apply(decl, args)
+        if op == "eq" and not isinstance(term.children[0].sort, int) \
+                and term.children[0].sort != BOOL:
+            raise NotImplementedError("array extensionality is not supported")
+        if not term.children:
+            return term
+        new_children = [self.lower(c) for c in term.children]
+        if all(a is b for a, b in zip(new_children, term.children)):
+            return term
+        return terms.rebuild(term, new_children)
+
+    def lower_index(self, index: Term) -> Term:
+        return self.lower(index)
+
+    def _lower_select(self, arr: Term, index: Term) -> Term:
+        """Unwind store/ite chains; terminate at base array / karray."""
+        if arr.op == "store":
+            base, widx, wval = arr.children
+            widx_l = self.lower(widx)
+            wval_l = self.lower(wval)
+            hit = terms.eq(index, widx_l)
+            if hit.is_const:
+                if hit.value:
+                    return wval_l
+                return self._lower_select(base, index)
+            return terms.ite(hit, wval_l, self._lower_select(base, index))
+        if arr.op == "karray":
+            return self.lower(arr.children[0])
+        if arr.op == "ite":
+            cond = self.lower(arr.children[0])
+            then = self._lower_select(arr.children[1], index)
+            otherwise = self._lower_select(arr.children[2], index)
+            return terms.ite(cond, then, otherwise)
+        if arr.op == "array":
+            return self._ackermann_select(arr, index)
+        raise NotImplementedError(f"select over {arr.op}")
+
+    def _ackermann_select(self, arr: Term, index: Term) -> Term:
+        name = arr.params[0]
+        rng = arr.sort[2]
+        reads = self.array_reads.setdefault(name, [])
+        for prev_index, prev_sym in reads:
+            if prev_index == index:
+                return prev_sym
+        sym = self.fresh(rng, f"sel!{name}")
+        # congruence with all previous reads of the same array
+        for prev_index, prev_sym in reads:
+            self.side_constraints.append(
+                terms.bool_or([
+                    terms.bool_not(terms.eq(index, prev_index)),
+                    terms.eq(sym, prev_sym),
+                ])
+            )
+        reads.append((index, sym))
+        return sym
+
+    def _ackermann_apply(self, decl: terms.FuncDecl, args: Tuple[Term, ...]) -> Term:
+        apps = self.func_apps.setdefault(decl.name, [])
+        for prev_args, prev_sym in apps:
+            if prev_args == args:
+                return prev_sym
+        sym = self.fresh(decl.range, f"app!{decl.name}")
+        for prev_args, prev_sym in apps:
+            same_args = terms.bool_and(
+                [terms.eq(a, b) for a, b in zip(args, prev_args)]
+            )
+            self.side_constraints.append(
+                terms.bool_or([terms.bool_not(same_args), terms.eq(sym, prev_sym)])
+            )
+        apps.append((args, sym))
+        return sym
+
+
+class _Prepared:
+    """Lowered + blasted problem state shared across assumption probes."""
+
+    __slots__ = ("trivial", "original", "lowering", "blaster",
+                 "num_vars", "clauses", "objective_bits")
+
+    def __init__(self):
+        self.trivial: Optional[str] = None
+        self.original: List[Term] = []
+        self.lowering: Optional[_Lowering] = None
+        self.blaster: Optional[Blaster] = None
+        self.num_vars = 0
+        self.clauses: List = []
+        self.objective_bits: List[List[int]] = []
+
+
+class Solver:
+    """Check a conjunction of Bool constraints; extract word-level models."""
+
+    def __init__(self, timeout: Optional[float] = None):
+        self.timeout = timeout  # seconds
+        self.constraints: List[Term] = []
+        self._model: Optional[Model] = None
+        self.conflict_budget = 0
+
+    def set_timeout(self, timeout_ms: int) -> None:
+        self.timeout = timeout_ms / 1000.0
+
+    def add(self, *constraints) -> None:
+        for c in constraints:
+            if isinstance(c, (list, tuple)):
+                self.add(*c)
+            else:
+                self.constraints.append(_raw(c))
+
+    append = add
+
+    def check(self, *extra) -> str:
+        stats = SolverStatistics()
+        start = time.monotonic()
+        try:
+            return self._check([_raw(e) for e in extra])
+        finally:
+            stats.add_query(time.monotonic() - start)
+
+    def _prepare(self, extra: List[Term],
+                 objectives: List[Term] = ()) -> "_Prepared":
+        """Simplify, lower, and blast the assertion set (+ objective bits)."""
+        prep = _Prepared()
+        asserted: List[Term] = []
+        for term in self.constraints + extra:
+            term = terms.simplify_expr(term)
+            if term.is_const:
+                if term.value is False:
+                    prep.trivial = UNSAT
+                    return prep
+                continue
+            asserted.append(term)
+        prep.original = asserted
+
+        lowering = _Lowering()
+        try:
+            lowered = [lowering.lower(t) for t in asserted]
+            lowered_objectives = [lowering.lower(o) for o in objectives]
+        except NotImplementedError:
+            prep.trivial = UNKNOWN
+            return prep
+        lowered += lowering.drain_side_constraints()
+        lowered = [terms.simplify_expr(t) for t in lowered]
+        if any(t.is_const and t.value is False for t in lowered):
+            prep.trivial = UNSAT
+            return prep
+        lowered = [t for t in lowered if not t.is_const]
+        if not lowered and not objectives:
+            prep.trivial = SAT
+            return prep
+
+        prep.lowering = lowering
+        prep.blaster = Blaster()
+        objective_lits: List[int] = []
+        prep.objective_bits = []
+        for lowered_obj in lowered_objectives:
+            bits = prep.blaster.bv_bits(lowered_obj)
+            prep.objective_bits.append(bits)
+            objective_lits.extend(bits)
+        prep.num_vars, prep.clauses = prep.blaster.cnf(lowered, objective_lits)
+        return prep
+
+    def _solve_prepared(self, prep: "_Prepared",
+                        assumptions: List[int] = ()) -> str:
+        status, bits = sat_backend.solve_cnf(
+            prep.num_vars,
+            prep.clauses,
+            assumptions=assumptions,
+            timeout_seconds=self.timeout or 0.0,
+            conflict_budget=self.conflict_budget,
+        )
+        if status == SAT:
+            self._model = self._reconstruct(
+                prep.blaster, bits, prep.lowering, prep.original
+            )
+        return status
+
+    def _check(self, extra: List[Term]) -> str:
+        self._model = None
+        prep = self._prepare(extra)
+        if prep.trivial is not None:
+            if prep.trivial == SAT:
+                self._model = Model({})
+            return prep.trivial
+        return self._solve_prepared(prep)
+
+    def _reconstruct(self, blaster: Blaster, bits: List[bool],
+                     lowering: _Lowering, original: List[Term]) -> Model:
+        assignment: Dict = {}
+        for name, var_list in blaster.bv_symbol_vars.items():
+            value = 0
+            for i, var in enumerate(var_list):
+                if bits[var]:
+                    value |= 1 << i
+            assignment[name] = value
+        for name, var in blaster.bool_symbol_vars.items():
+            assignment[name] = bits[var]
+        # rebuild array tables from the ackermannized reads
+        for arr_name, reads in lowering.array_reads.items():
+            entries = {}
+            for index_term, sym_term in reads:
+                index_value = evaluate(index_term, assignment)
+                entries[index_value] = assignment.get(sym_term.params[0], 0)
+            assignment[arr_name] = (0, entries)
+        # rebuild UF tables
+        for func_name, apps in lowering.func_apps.items():
+            table = {}
+            for args_terms, sym_term in apps:
+                key = tuple(evaluate(a, assignment) for a in args_terms)
+                table[key] = assignment.get(sym_term.params[0], 0)
+            assignment[func_name] = (0, table)
+        # drop internal fresh symbols from the visible model
+        visible = {k: v for k, v in assignment.items()
+                   if not (isinstance(k, str) and k.startswith("!"))}
+        model = Model(visible)
+        # soundness net: the model must satisfy the ORIGINAL constraints
+        for term in original:
+            if evaluate(term, model.assignment) is not True:
+                raise SolverInternalError(
+                    f"model validation failed on {terms.term_to_str(term)}"
+                )
+        return model
+
+    def model(self) -> Model:
+        if self._model is None:
+            raise ValueError("no model available (last check not sat)")
+        return self._model
+
+
+class Optimize(Solver):
+    """Lexicographic minimize/maximize via MSB-first bit fixing.
+
+    The problem is lowered and blasted ONCE; each bit probe is a SAT call
+    under assumptions on the shared CNF (no re-lowering/re-blasting)."""
+
+    def __init__(self, timeout: Optional[float] = None):
+        super().__init__(timeout)
+        self._objectives: List[Tuple[str, Term]] = []
+
+    def minimize(self, expression) -> None:
+        self._objectives.append(("min", _raw(expression)))
+
+    def maximize(self, expression) -> None:
+        self._objectives.append(("max", _raw(expression)))
+
+    def _check(self, extra: List[Term]) -> str:
+        if not self._objectives:
+            return super()._check(extra)
+        self._model = None
+        prep = self._prepare(extra, [obj for _, obj in self._objectives])
+        if prep.trivial is not None:
+            if prep.trivial == SAT:
+                self._model = Model({})
+            return prep.trivial
+        status = self._solve_prepared(prep)
+        if status != SAT:
+            return status
+        deadline = time.monotonic() + (self.timeout or 10.0)
+        assumptions: List[int] = []  # DIMACS lits, grown lexicographically
+        for (direction, _), bit_lits in zip(self._objectives, prep.objective_bits):
+            if time.monotonic() > deadline:
+                break
+            self._optimize_one(direction, bit_lits, prep, assumptions, deadline)
+        return SAT
+
+    def _optimize_one(self, direction: str, bit_lits: List[int],
+                      prep: "_Prepared", assumptions: List[int],
+                      deadline: float) -> None:
+        """Fix objective bits MSB-first, appending to `assumptions` in place.
+
+        `bit_lits` are AIG literals (LSB-first); constant bits are skipped,
+        the rest are probed as SAT assumptions over the shared CNF. The best
+        model found is kept in self._model."""
+        prefer_negative = direction == "min"
+        for aig_lit in reversed(bit_lits):  # MSB first
+            if time.monotonic() > deadline:
+                return
+            var = aig_lit >> 1
+            if var == 0:
+                continue  # constant bit: nothing to decide
+            dimacs = -var if aig_lit & 1 else var
+            trial = -dimacs if prefer_negative else dimacs
+            saved = self.timeout
+            self.timeout = max(0.25, deadline - time.monotonic())
+            try:
+                status = self._solve_prepared_keep_model(
+                    prep, assumptions + [trial])
+            finally:
+                self.timeout = saved
+            if status == SAT:
+                assumptions.append(trial)
+            elif status == UNSAT:
+                assumptions.append(-trial)
+            else:
+                return
+
+    def _solve_prepared_keep_model(self, prep, assumptions) -> str:
+        """Like _solve_prepared but keeps the previous model on non-SAT."""
+        saved = self._model
+        status = self._solve_prepared(prep, assumptions)
+        if status != SAT:
+            self._model = saved
+        return status

@@ -1,0 +1,303 @@
+"""CNF SAT backends.
+
+Primary: the C++ CDCL solver in native/sat.cpp, compiled on first use with
+g++ (no pybind11 in this environment — plain C ABI via ctypes). Fallback:
+a compact pure-Python CDCL, used when no compiler is available and by the
+test suite for differential checks.
+"""
+
+import ctypes
+import os
+import subprocess
+import tempfile
+import threading
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+_SOURCE = os.path.join(_REPO_ROOT, "native", "sat.cpp")
+_BUILD_DIR = os.path.join(_REPO_ROOT, "native", "_build")
+
+SAT, UNSAT, UNKNOWN = "sat", "unsat", "unknown"
+
+_lib = None
+_lib_lock = threading.Lock()
+_native_failed = False
+
+
+def _compile_native() -> Optional[ctypes.CDLL]:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    so_path = os.path.join(_BUILD_DIR, "libsat.so")
+    if (not os.path.exists(so_path)
+            or os.path.getmtime(so_path) < os.path.getmtime(_SOURCE)):
+        with tempfile.NamedTemporaryFile(
+            suffix=".so", dir=_BUILD_DIR, delete=False
+        ) as tmp:
+            tmp_path = tmp.name
+        cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+               "-o", tmp_path, _SOURCE]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            os.replace(tmp_path, so_path)
+        except (subprocess.SubprocessError, OSError):
+            if os.path.exists(tmp_path):
+                os.unlink(tmp_path)
+            return None
+    lib = ctypes.CDLL(so_path)
+    lib.sat_solve.restype = ctypes.c_int
+    lib.sat_solve.argtypes = [
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_longlong),
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int),
+        ctypes.c_int,
+        ctypes.c_double,
+        ctypes.c_longlong,
+        ctypes.POINTER(ctypes.c_byte),
+    ]
+    return lib
+
+
+def _get_native():
+    global _lib, _native_failed
+    if _lib is not None or _native_failed:
+        return _lib
+    with _lib_lock:
+        if _lib is None and not _native_failed:
+            _lib = _compile_native()
+            if _lib is None:
+                _native_failed = True
+    return _lib
+
+
+def solve_cnf(
+    num_vars: int,
+    clauses: Sequence[Tuple[int, ...]],
+    assumptions: Iterable[int] = (),
+    timeout_seconds: float = 0.0,
+    conflict_budget: int = 0,
+) -> Tuple[str, Optional[List[bool]]]:
+    """Solve CNF with DIMACS-signed literals.
+
+    Returns (status, model) where model[v] is the boolean of var v (1-based),
+    or None unless SAT.
+    """
+    assumptions = list(assumptions)
+    lib = _get_native()
+    if lib is not None:
+        return _solve_native(lib, num_vars, clauses, assumptions,
+                             timeout_seconds, conflict_budget)
+    return _solve_python(num_vars, clauses, assumptions, timeout_seconds,
+                         conflict_budget)
+
+
+def _solve_native(lib, num_vars, clauses, assumptions, timeout_seconds,
+                  conflict_budget):
+    flat: List[int] = []
+    offsets: List[int] = [0]
+    for clause in clauses:
+        flat.extend(clause)
+        offsets.append(len(flat))
+    lits_arr = (ctypes.c_int * max(len(flat), 1))(*flat)
+    offs_arr = (ctypes.c_longlong * len(offsets))(*offsets)
+    assume_arr = (ctypes.c_int * max(len(assumptions), 1))(*assumptions)
+    model_arr = (ctypes.c_byte * (num_vars + 1))()
+    status = lib.sat_solve(
+        num_vars, lits_arr, offs_arr, len(clauses), assume_arr,
+        len(assumptions), float(timeout_seconds), int(conflict_budget),
+        model_arr,
+    )
+    if status == 10:
+        return SAT, [bool(model_arr[v]) for v in range(num_vars + 1)]
+    if status == 20:
+        return UNSAT, None
+    return UNKNOWN, None
+
+
+# ---------------------------------------------------------------------------
+# pure-Python fallback CDCL (watched literals, VSIDS-lite; assumptions are
+# applied as unit clauses — sound for one-shot solving)
+
+
+def _solve_python(num_vars, clauses, assumptions, timeout_seconds,
+                  conflict_budget=0):
+    import time as _time
+
+    deadline = _time.monotonic() + timeout_seconds if timeout_seconds else None
+
+    # preprocess: dedupe lits, drop tautologies
+    db: List[List[int]] = []
+    units: List[int] = list(assumptions)
+    for clause in clauses:
+        lits = sorted(set(clause))
+        if not lits:
+            return UNSAT, None
+        if any(-l in lits for l in lits):
+            continue
+        if len(lits) == 1:
+            units.append(lits[0])
+        else:
+            db.append(lits)
+
+    assign = {}          # var -> bool
+    level = {}
+    reason = {}
+    trail: List[int] = []
+    trail_lim: List[int] = []
+    watches = {}         # lit -> list of clause indices watching -lit ... use neg map
+    activity = [0.0] * (num_vars + 1)
+    var_inc = 1.0
+
+    for ci, lits in enumerate(db):
+        for lit in lits[:2]:
+            watches.setdefault(-lit, []).append(ci)
+
+    def lit_value(lit):
+        v = assign.get(abs(lit))
+        if v is None:
+            return None
+        return v if lit > 0 else not v
+
+    def enqueue(lit, r):
+        var = abs(lit)
+        if var in assign:
+            return lit_value(lit)
+        assign[var] = lit > 0
+        level[var] = len(trail_lim)
+        reason[var] = r
+        trail.append(lit)
+        return True
+
+    def propagate():
+        while propagate.qhead < len(trail):
+            p = trail[propagate.qhead]
+            propagate.qhead += 1
+            watching = watches.get(p, [])
+            i = 0
+            while i < len(watching):
+                ci = watching[i]
+                lits = db[ci]
+                if lits[0] == -p:
+                    lits[0], lits[1] = lits[1], lits[0]
+                if lit_value(lits[0]) is True:
+                    i += 1
+                    continue
+                moved = False
+                for k in range(2, len(lits)):
+                    if lit_value(lits[k]) is not False:
+                        lits[1], lits[k] = lits[k], lits[1]
+                        watches.setdefault(-lits[1], []).append(ci)
+                        watching[i] = watching[-1]
+                        watching.pop()
+                        moved = True
+                        break
+                if moved:
+                    continue
+                if lit_value(lits[0]) is False:
+                    propagate.qhead = len(trail)
+                    return ci
+                enqueue(lits[0], ci)
+                i += 1
+        return None
+    propagate.qhead = 0
+
+    def rescale_activity():
+        nonlocal var_inc
+        if var_inc > 1e100:
+            for v in range(len(activity)):
+                activity[v] *= 1e-100
+            var_inc *= 1e-100
+
+    def analyze(ci):
+        nonlocal var_inc
+        learnt = [None]
+        counter = 0
+        seen = set()
+        p = None
+        index = len(trail)
+        while True:
+            lits = db[ci] if ci is not None else []
+            start = 0 if p is None else 1
+            for lit in lits[start:]:
+                var = abs(lit)
+                if var not in seen and level.get(var, 0) > 0:
+                    seen.add(var)
+                    activity[var] += var_inc
+                    if level[var] >= len(trail_lim):
+                        counter += 1
+                    else:
+                        learnt.append(lit)
+            while True:
+                index -= 1
+                if abs(trail[index]) in seen:
+                    break
+            p = trail[index]
+            ci = reason.get(abs(p))
+            seen.discard(abs(p))
+            counter -= 1
+            if counter == 0:
+                break
+        learnt[0] = -p
+        var_inc /= 0.95
+        rescale_activity()
+        if len(learnt) == 1:
+            return learnt, 0
+        bt = max(level[abs(l)] for l in learnt[1:])
+        max_i = max(range(1, len(learnt)), key=lambda i: level[abs(learnt[i])])
+        learnt[1], learnt[max_i] = learnt[max_i], learnt[1]
+        return learnt, bt
+
+    def cancel_until(lvl):
+        while len(trail_lim) > lvl:
+            mark = trail_lim.pop()
+            while len(trail) > mark:
+                lit = trail.pop()
+                var = abs(lit)
+                del assign[var]
+                level.pop(var, None)
+                reason.pop(var, None)
+        propagate.qhead = len(trail)
+
+    for unit in units:
+        if enqueue(unit, None) is False:
+            return UNSAT, None
+    if propagate() is not None:
+        return UNSAT, None
+
+    conflicts = 0
+    while True:
+        confl = propagate()
+        if confl is not None:
+            conflicts += 1
+            if deadline and conflicts % 256 == 0 and _time.monotonic() > deadline:
+                return UNKNOWN, None
+            if conflict_budget and conflicts > conflict_budget:
+                return UNKNOWN, None
+            if not trail_lim:
+                return UNSAT, None
+            learnt, bt = analyze(confl)
+            cancel_until(bt)
+            if len(learnt) == 1:
+                if enqueue(learnt[0], None) is False:
+                    return UNSAT, None
+            else:
+                db.append(learnt)
+                ci = len(db) - 1
+                for lit in learnt[:2]:
+                    watches.setdefault(-lit, []).append(ci)
+                enqueue(learnt[0], ci)
+        else:
+            free = None
+            best = -1.0
+            for var in range(1, num_vars + 1):
+                if var not in assign and activity[var] > best:
+                    best = activity[var]
+                    free = var
+            if free is None:
+                model = [False] * (num_vars + 1)
+                for var, val in assign.items():
+                    model[var] = val
+                return SAT, model
+            trail_lim.append(len(trail))
+            enqueue(-free, None)

@@ -1,0 +1,42 @@
+"""Query-count/time singleton (reference laser/smt/solver/solver_statistics.py)."""
+
+import time
+from functools import wraps
+
+
+class SolverStatistics:
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+            cls._instance.enabled = False
+            cls._instance.query_count = 0
+            cls._instance.solver_time = 0.0
+        return cls._instance
+
+    def add_query(self, seconds: float) -> None:
+        if self.enabled:
+            self.query_count += 1
+            self.solver_time += seconds
+
+    def reset(self) -> None:
+        self.query_count = 0
+        self.solver_time = 0.0
+
+    def __repr__(self):
+        return (f"Solver statistics: query count: {self.query_count}, "
+                f"solver time: {self.solver_time:.3f}")
+
+
+def stat_smt_query(func):
+    @wraps(func)
+    def wrapped(*args, **kwargs):
+        stats = SolverStatistics()
+        start = time.monotonic()
+        try:
+            return func(*args, **kwargs)
+        finally:
+            stats.add_query(time.monotonic() - start)
+
+    return wrapped

@@ -1,0 +1,619 @@
+"""Core immutable term DAG for the self-contained SMT stack.
+
+Every expression is a `Term`: (op, children, params, sort). Constant folding
+happens eagerly at construction; `simplify_expr` applies a deeper local
+rewrite pass. Sorts: positive int = bitvector width; BOOL; ("arr", dom, rng).
+
+The user-facing wrappers (BitVec/Bool/Array in sibling modules) hold a Term
+plus mythril-style annotations; this module knows nothing about annotations.
+"""
+
+from typing import Dict, Iterable, Optional, Tuple
+
+BOOL = "bool"
+
+
+def arr_sort(dom: int, rng: int) -> Tuple[str, int, int]:
+    return ("arr", dom, rng)
+
+
+def _mask(size: int) -> int:
+    return (1 << size) - 1
+
+
+def to_signed(value: int, size: int) -> int:
+    return value - (1 << size) if value >> (size - 1) else value
+
+
+def to_unsigned(value: int, size: int) -> int:
+    return value & _mask(size)
+
+
+class Term:
+    __slots__ = ("op", "children", "params", "sort", "_hash", "is_const", "value")
+
+    def __init__(self, op, children, params, sort, value=None):
+        self.op = op
+        self.children = children  # tuple of Term
+        self.params = params      # tuple of static data (ints, names, FuncDecl)
+        self.sort = sort
+        self.value = value        # int/bool when is_const
+        self.is_const = value is not None
+        self._hash = hash(
+            (op, params, sort, value, tuple(c._hash for c in children))
+        )
+
+    def __hash__(self):
+        return self._hash
+
+    def __eq__(self, other):
+        if self is other:
+            return True
+        if not isinstance(other, Term):
+            return NotImplemented
+        if self._hash != other._hash:
+            return False
+        # iterative structural comparison (DAGs can be deep)
+        stack = [(self, other)]
+        seen = set()
+        while stack:
+            a, b = stack.pop()
+            if a is b:
+                continue
+            key = (id(a), id(b))
+            if key in seen:
+                continue
+            seen.add(key)
+            if (
+                a.op != b.op
+                or a.params != b.params
+                or a.sort != b.sort
+                or a.value != b.value
+                or len(a.children) != len(b.children)
+            ):
+                return False
+            stack.extend(zip(a.children, b.children))
+        return True
+
+    def __repr__(self):
+        return term_to_str(self, max_depth=4)
+
+    @property
+    def size(self) -> int:
+        assert isinstance(self.sort, int), f"not a bitvector: {self.sort}"
+        return self.sort
+
+
+# ---------------------------------------------------------------------------
+# constructors with eager folding
+
+
+TRUE = Term("true", (), (), BOOL, True)
+FALSE = Term("false", (), (), BOOL, False)
+
+
+def bool_val(value: bool) -> Term:
+    return TRUE if value else FALSE
+
+
+def bv_val(value: int, size: int) -> Term:
+    return Term("const", (), (), size, value & _mask(size))
+
+
+def bv_sym(name: str, size: int) -> Term:
+    return Term("sym", (), (name,), size)
+
+
+def bool_sym(name: str) -> Term:
+    return Term("sym", (), (name,), BOOL)
+
+
+_COMMUTATIVE = {"bvadd", "bvmul", "bvand", "bvor", "bvxor", "eq", "and", "or", "xor"}
+
+
+def _fold2(op, a: int, b: int, size: int) -> int:
+    if op == "bvadd":
+        return (a + b) & _mask(size)
+    if op == "bvsub":
+        return (a - b) & _mask(size)
+    if op == "bvmul":
+        return (a * b) & _mask(size)
+    if op == "bvudiv":
+        return (a // b) & _mask(size) if b else 0  # EVM: div by zero -> 0
+    if op == "bvurem":
+        return (a % b) & _mask(size) if b else 0
+    if op == "bvsdiv":
+        if b == 0:
+            return 0
+        sa, sb = to_signed(a, size), to_signed(b, size)
+        q = abs(sa) // abs(sb)
+        if (sa < 0) != (sb < 0):
+            q = -q
+        return to_unsigned(q, size)
+    if op == "bvsrem":
+        if b == 0:
+            return 0
+        sa, sb = to_signed(a, size), to_signed(b, size)
+        r = abs(sa) % abs(sb)
+        if sa < 0:
+            r = -r
+        return to_unsigned(r, size)
+    if op == "bvand":
+        return a & b
+    if op == "bvor":
+        return a | b
+    if op == "bvxor":
+        return a ^ b
+    if op == "bvshl":
+        return (a << b) & _mask(size) if b < size else 0
+    if op == "bvlshr":
+        return a >> b if b < size else 0
+    if op == "bvashr":
+        sa = to_signed(a, size)
+        return to_unsigned(sa >> min(b, size - 1), size)
+    raise NotImplementedError(op)
+
+
+def bv_binop(op: str, a: Term, b: Term) -> Term:
+    assert a.sort == b.sort, f"width mismatch {a.sort} vs {b.sort} in {op}"
+    size = a.size
+    if a.is_const and b.is_const:
+        return bv_val(_fold2(op, a.value, b.value, size), size)
+    # normalize constants left for commutative ops
+    if op in _COMMUTATIVE and b.is_const and not a.is_const:
+        a, b = b, a
+    # identity / annihilator rewrites
+    if a.is_const:
+        v = a.value
+        if op == "bvadd" and v == 0:
+            return b
+        if op == "bvmul":
+            if v == 0:
+                return a
+            if v == 1:
+                return b
+        if op == "bvand":
+            if v == 0:
+                return a
+            if v == _mask(size):
+                return b
+        if op == "bvor":
+            if v == 0:
+                return b
+            if v == _mask(size):
+                return a
+        if op == "bvxor" and v == 0:
+            return b
+    if b.is_const:
+        v = b.value
+        if op in ("bvsub", "bvshl", "bvlshr", "bvashr") and v == 0:
+            return a
+        if op in ("bvudiv", "bvsdiv") and v == 1:
+            return a
+        if op in ("bvshl", "bvlshr") and v >= size:
+            return bv_val(0, size)
+    if op == "bvsub" and a == b:
+        return bv_val(0, size)
+    if op == "bvxor" and a == b:
+        return bv_val(0, size)
+    return Term(op, (a, b), (), size)
+
+
+def bv_not(a: Term) -> Term:
+    if a.is_const:
+        return bv_val(~a.value, a.size)
+    if a.op == "bvnot":
+        return a.children[0]
+    return Term("bvnot", (a,), (), a.size)
+
+
+def bv_neg(a: Term) -> Term:
+    if a.is_const:
+        return bv_val(-a.value, a.size)
+    return Term("bvneg", (a,), (), a.size)
+
+
+def concat(parts: Iterable[Term]) -> Term:
+    """MSB-first concatenation; merges adjacent constants."""
+    flat = []
+    for p in parts:
+        if p.op == "concat":
+            flat.extend(p.children)
+        else:
+            flat.append(p)
+    assert flat, "empty concat"
+    merged = [flat[0]]
+    for p in flat[1:]:
+        last = merged[-1]
+        if p.is_const and last.is_const:
+            merged[-1] = bv_val((last.value << p.size) | p.value, last.size + p.size)
+        else:
+            merged.append(p)
+    if len(merged) == 1:
+        return merged[0]
+    total = sum(p.size for p in merged)
+    return Term("concat", tuple(merged), (), total)
+
+
+def extract(hi: int, lo: int, a: Term) -> Term:
+    assert 0 <= lo <= hi < a.size, f"bad extract [{hi}:{lo}] of {a.size}"
+    width = hi - lo + 1
+    if width == a.size:
+        return a
+    if a.is_const:
+        return bv_val(a.value >> lo, width)
+    if a.op == "extract":
+        inner_lo = a.params[1]
+        return extract(hi + inner_lo, lo + inner_lo, a.children[0])
+    if a.op == "concat":
+        # narrow into the covered children
+        offset = a.size
+        pieces = []
+        for child in a.children:
+            offset -= child.size
+            child_hi = offset + child.size - 1
+            if child_hi < lo or offset > hi:
+                continue
+            take_hi = min(hi, child_hi) - offset
+            take_lo = max(lo, offset) - offset
+            pieces.append(extract(take_hi, take_lo, child))
+        if pieces:
+            return concat(pieces)
+    if a.op in ("zext", "sext") and hi < a.children[0].size:
+        return extract(hi, lo, a.children[0])
+    if a.op == "zext" and lo >= a.children[0].size:
+        return bv_val(0, width)
+    return Term("extract", (a,), (hi, lo), width)
+
+
+def zext(extra: int, a: Term) -> Term:
+    if extra == 0:
+        return a
+    if a.is_const:
+        return bv_val(a.value, a.size + extra)
+    return Term("zext", (a,), (extra,), a.size + extra)
+
+
+def sext(extra: int, a: Term) -> Term:
+    if extra == 0:
+        return a
+    if a.is_const:
+        return bv_val(to_signed(a.value, a.size), a.size + extra)
+    return Term("sext", (a,), (extra,), a.size + extra)
+
+
+def eq(a: Term, b: Term) -> Term:
+    assert a.sort == b.sort, f"sort mismatch in eq: {a.sort} vs {b.sort}"
+    if a.is_const and b.is_const:
+        return bool_val(a.value == b.value)
+    if a == b:
+        return TRUE
+    if b.is_const and not a.is_const:
+        a, b = b, a
+    return Term("eq", (a, b), (), BOOL)
+
+
+def bv_cmp(op: str, a: Term, b: Term) -> Term:
+    assert a.sort == b.sort and isinstance(a.sort, int)
+    size = a.size
+    if a.is_const and b.is_const:
+        if op == "bvult":
+            return bool_val(a.value < b.value)
+        if op == "bvule":
+            return bool_val(a.value <= b.value)
+        if op == "bvslt":
+            return bool_val(to_signed(a.value, size) < to_signed(b.value, size))
+        if op == "bvsle":
+            return bool_val(to_signed(a.value, size) <= to_signed(b.value, size))
+    if a == b:
+        return TRUE if op in ("bvule", "bvsle") else FALSE
+    if op == "bvult" and b.is_const and b.value == 0:
+        return FALSE
+    if op == "bvule" and a.is_const and a.value == 0:
+        return TRUE
+    return Term(op, (a, b), (), BOOL)
+
+
+def bool_and(parts: Iterable[Term]) -> Term:
+    flat = []
+    for p in parts:
+        assert p.sort == BOOL
+        if p.is_const:
+            if not p.value:
+                return FALSE
+            continue
+        if p.op == "and":
+            flat.extend(p.children)
+        else:
+            flat.append(p)
+    # dedupe preserving order
+    seen, uniq = set(), []
+    for p in flat:
+        if p._hash not in seen:
+            seen.add(p._hash)
+            uniq.append(p)
+    if not uniq:
+        return TRUE
+    if len(uniq) == 1:
+        return uniq[0]
+    return Term("and", tuple(uniq), (), BOOL)
+
+
+def bool_or(parts: Iterable[Term]) -> Term:
+    flat = []
+    for p in parts:
+        assert p.sort == BOOL
+        if p.is_const:
+            if p.value:
+                return TRUE
+            continue
+        if p.op == "or":
+            flat.extend(p.children)
+        else:
+            flat.append(p)
+    seen, uniq = set(), []
+    for p in flat:
+        if p._hash not in seen:
+            seen.add(p._hash)
+            uniq.append(p)
+    if not uniq:
+        return FALSE
+    if len(uniq) == 1:
+        return uniq[0]
+    return Term("or", tuple(uniq), (), BOOL)
+
+
+def bool_not(a: Term) -> Term:
+    if a.is_const:
+        return bool_val(not a.value)
+    if a.op == "not":
+        return a.children[0]
+    return Term("not", (a,), (), BOOL)
+
+
+def bool_xor(a: Term, b: Term) -> Term:
+    if a.is_const and b.is_const:
+        return bool_val(a.value != b.value)
+    if a.is_const:
+        return b if a.value is False else bool_not(b)
+    if b.is_const:
+        return a if b.value is False else bool_not(a)
+    return Term("xor", (a, b), (), BOOL)
+
+
+def ite(cond: Term, then: Term, otherwise: Term) -> Term:
+    assert cond.sort == BOOL
+    assert then.sort == otherwise.sort
+    if cond.is_const:
+        return then if cond.value else otherwise
+    if then == otherwise:
+        return then
+    if then.sort == BOOL:
+        if then is TRUE and otherwise is FALSE:
+            return cond
+        if then is FALSE and otherwise is TRUE:
+            return bool_not(cond)
+    return Term("ite", (cond, then, otherwise), (), then.sort)
+
+
+# ---------------------------------------------------------------------------
+# arrays (functional: base symbol / const K / store chains)
+
+
+def array_sym(name: str, dom: int, rng: int) -> Term:
+    return Term("array", (), (name,), arr_sort(dom, rng))
+
+
+def const_array(dom: int, value: Term) -> Term:
+    return Term("karray", (value,), (), arr_sort(dom, value.size))
+
+
+def store(arr: Term, index: Term, value: Term) -> Term:
+    _, dom, rng = arr.sort
+    assert index.sort == dom and value.sort == rng
+    return Term("store", (arr, index, value), (), arr.sort)
+
+
+def select(arr: Term, index: Term) -> Term:
+    _, dom, rng = arr.sort
+    assert index.sort == dom, f"index width {index.sort} != {dom}"
+    # read-over-write elimination when decidable syntactically
+    probe = arr
+    while True:
+        if probe.op == "store":
+            base, widx, wval = probe.children
+            if index == widx:
+                return wval
+            if index.is_const and widx.is_const:
+                probe = base  # definitely distinct, skip this write
+                continue
+            break  # may alias: keep the select on the original chain
+        if probe.op == "karray":
+            return probe.children[0]
+        break
+    return Term("select", (arr, index), (), rng)
+
+
+# ---------------------------------------------------------------------------
+# uninterpreted functions
+
+
+class FuncDecl:
+    __slots__ = ("name", "domain", "range")
+
+    def __init__(self, name: str, domain: Tuple[int, ...], range_: int):
+        self.name = name
+        self.domain = domain
+        self.range = range_
+
+    def __repr__(self):
+        return f"FuncDecl({self.name}: {self.domain} -> {self.range})"
+
+    def __hash__(self):
+        return hash((self.name, self.domain, self.range))
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, FuncDecl)
+            and self.name == other.name
+            and self.domain == other.domain
+            and self.range == other.range
+        )
+
+
+def apply_func(func: FuncDecl, args: Tuple[Term, ...]) -> Term:
+    assert tuple(a.sort for a in args) == func.domain, (
+        f"{func}: bad arg sorts {[a.sort for a in args]}"
+    )
+    return Term("apply", tuple(args), (func,), func.range)
+
+
+# ---------------------------------------------------------------------------
+# traversal helpers
+
+
+def walk_terms(roots):
+    """Post-order unique traversal over a DAG (iterative)."""
+    seen = set()
+    order = []
+    stack = [(r, False) for r in roots]
+    while stack:
+        node, expanded = stack.pop()
+        if id(node) in seen:
+            continue
+        if expanded:
+            seen.add(id(node))
+            order.append(node)
+        else:
+            stack.append((node, True))
+            for child in node.children:
+                if id(child) not in seen:
+                    stack.append((child, False))
+    return order
+
+
+def free_symbols(roots) -> Dict[Tuple[str, object], Term]:
+    """All 'sym' and 'array' leaves, keyed by (name, sort)."""
+    out = {}
+    for node in walk_terms(roots):
+        if node.op in ("sym", "array"):
+            out[(node.params[0], node.sort)] = node
+    return out
+
+
+def term_to_str(term: Term, max_depth: int = 12) -> str:
+    if max_depth < 0:
+        return "…"
+    if term.op == "const":
+        return f"{term.value:#x}[{term.size}]" if term.size > 8 else f"{term.value}[{term.size}]"
+    if term.op in ("true", "false"):
+        return term.op
+    if term.op in ("sym", "array"):
+        return str(term.params[0])
+    if term.op == "apply":
+        inner = ", ".join(term_to_str(c, max_depth - 1) for c in term.children)
+        return f"{term.params[0].name}({inner})"
+    if term.op == "extract":
+        hi, lo = term.params
+        return f"extract[{hi}:{lo}]({term_to_str(term.children[0], max_depth - 1)})"
+    inner = ", ".join(term_to_str(c, max_depth - 1) for c in term.children)
+    return f"{term.op}({inner})"
+
+
+# rebuild map used by substitution / simplification
+_CONSTRUCTORS = {}
+
+
+def rebuild(term: Term, new_children) -> Term:
+    """Re-run the smart constructor for `term` over new children."""
+    op = term.op
+    if op in ("const", "sym", "array", "true", "false"):
+        return term
+    c = tuple(new_children)
+    if op in ("bvadd", "bvsub", "bvmul", "bvudiv", "bvurem", "bvsdiv", "bvsrem",
+              "bvand", "bvor", "bvxor", "bvshl", "bvlshr", "bvashr"):
+        return bv_binop(op, c[0], c[1])
+    if op == "bvnot":
+        return bv_not(c[0])
+    if op == "bvneg":
+        return bv_neg(c[0])
+    if op == "concat":
+        return concat(c)
+    if op == "extract":
+        return extract(term.params[0], term.params[1], c[0])
+    if op == "zext":
+        return zext(term.params[0], c[0])
+    if op == "sext":
+        return sext(term.params[0], c[0])
+    if op == "eq":
+        return eq(c[0], c[1])
+    if op in ("bvult", "bvule", "bvslt", "bvsle"):
+        return bv_cmp(op, c[0], c[1])
+    if op == "and":
+        return bool_and(c)
+    if op == "or":
+        return bool_or(c)
+    if op == "not":
+        return bool_not(c[0])
+    if op == "xor":
+        return bool_xor(c[0], c[1])
+    if op == "ite":
+        return ite(c[0], c[1], c[2])
+    if op == "store":
+        return store(c[0], c[1], c[2])
+    if op == "select":
+        return select(c[0], c[1])
+    if op == "karray":
+        return const_array(term.sort[1], c[0])
+    if op == "apply":
+        return apply_func(term.params[0], c)
+    raise NotImplementedError(op)
+
+
+def substitute(roots, mapping: Dict[Term, Term]):
+    """Replace occurrences (by structural equality) throughout a DAG."""
+    cache: Dict[int, Term] = {}
+    lookup = {t._hash: (t, r) for t, r in mapping.items()}
+
+    def subst(node: Term) -> Term:
+        hit = cache.get(id(node))
+        if hit is not None:
+            return hit
+        pair = lookup.get(node._hash)
+        if pair is not None and pair[0] == node:
+            cache[id(node)] = pair[1]
+            return pair[1]
+        if not node.children:
+            cache[id(node)] = node
+            return node
+        new_children = [subst(c) for c in node.children]
+        if all(a is b for a, b in zip(new_children, node.children)):
+            result = node
+        else:
+            result = rebuild(node, new_children)
+        cache[id(node)] = result
+        return result
+
+    # iterative wrapper to avoid recursion limits on deep chains
+    import sys
+    old_limit = sys.getrecursionlimit()
+    sys.setrecursionlimit(max(old_limit, 100000))
+    try:
+        return [subst(r) for r in roots]
+    finally:
+        sys.setrecursionlimit(old_limit)
+
+
+def simplify_expr(term: Term) -> Term:
+    """Bottom-up re-application of all smart constructors."""
+    cache: Dict[int, Term] = {}
+    for node in walk_terms([term]):
+        if not node.children:
+            cache[id(node)] = node
+            continue
+        new_children = [cache[id(c)] for c in node.children]
+        if all(a is b for a, b in zip(new_children, node.children)):
+            cache[id(node)] = node
+        else:
+            cache[id(node)] = rebuild(node, new_children)
+    return cache[id(term)]

@@ -1,0 +1,485 @@
+// CDCL SAT solver — the host-side decision engine behind mythril_tpu's
+// bit-blasted QF_BV checks (role of z3's SAT core in the reference; this
+// environment ships no z3, so this is the ground-truth backend).
+//
+// Minisat-style architecture: two-watched-literal propagation, VSIDS on a
+// binary max-heap, phase saving, 1UIP conflict learning with recursive-lite
+// minimization, Luby restarts, LBD-tiered learnt-clause reduction, and
+// solving under assumptions (used by the Optimize bitwise binary search).
+//
+// C ABI (ctypes):
+//   sat_solve(num_vars, clause_lits, clause_offsets, num_clauses,
+//             assumptions, num_assumptions, timeout_s, conflict_budget,
+//             model_out) -> 10 SAT / 20 UNSAT / 0 UNKNOWN
+// Literals are DIMACS signed ints; model_out[v] in {0,1} for v in 1..num_vars.
+
+#include <algorithm>
+#include <chrono>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+using Lit = int32_t;  // 2*var + sign, var in [0, n)
+using Var = int32_t;
+
+inline Lit mk_lit(Var v, bool sign) { return 2 * v + (sign ? 1 : 0); }
+inline Var lit_var(Lit l) { return l >> 1; }
+inline bool lit_sign(Lit l) { return l & 1; }
+inline Lit lit_neg(Lit l) { return l ^ 1; }
+
+constexpr int8_t kUndef = 0, kTrue = 1, kFalse = -1;
+
+struct Clause {
+  std::vector<Lit> lits;
+  bool learnt = false;
+  int lbd = 0;
+  double activity = 0.0;
+};
+
+struct Watcher {
+  int clause_idx;
+  Lit blocker;
+};
+
+// classic minisat luby
+static double luby(double y, int x) {
+  int size, seq;
+  for (size = 1, seq = 0; size < x + 1; seq++, size = 2 * size + 1) {}
+  while (size - 1 != x) {
+    size = (size - 1) >> 1;
+    seq--;
+    x = x % size;
+  }
+  return std::pow(y, seq);
+}
+
+class VarHeap {
+ public:
+  explicit VarHeap(int n, const std::vector<double>& act)
+      : pos_(n, -1), act_(act) {
+    heap_.reserve(n);
+    for (Var v = 0; v < n; ++v) insert(v);
+  }
+
+  bool empty() const { return heap_.empty(); }
+  bool contains(Var v) const { return pos_[v] >= 0; }
+
+  void insert(Var v) {
+    if (contains(v)) return;
+    pos_[v] = (int)heap_.size();
+    heap_.push_back(v);
+    up((int)heap_.size() - 1);
+  }
+
+  void increased(Var v) {
+    if (contains(v)) up(pos_[v]);
+  }
+
+  Var pop_max() {
+    Var top = heap_[0];
+    pos_[top] = -1;
+    Var last = heap_.back();
+    heap_.pop_back();
+    if (!heap_.empty()) {
+      heap_[0] = last;
+      pos_[last] = 0;
+      down(0);
+    }
+    return top;
+  }
+
+ private:
+  std::vector<Var> heap_;
+  std::vector<int> pos_;
+  const std::vector<double>& act_;
+
+  bool lt(Var a, Var b) const { return act_[a] < act_[b]; }
+
+  void up(int i) {
+    Var v = heap_[i];
+    while (i > 0) {
+      int parent = (i - 1) >> 1;
+      if (!lt(heap_[parent], v)) break;
+      heap_[i] = heap_[parent];
+      pos_[heap_[i]] = i;
+      i = parent;
+    }
+    heap_[i] = v;
+    pos_[v] = i;
+  }
+
+  void down(int i) {
+    Var v = heap_[i];
+    int n = (int)heap_.size();
+    for (;;) {
+      int child = 2 * i + 1;
+      if (child >= n) break;
+      if (child + 1 < n && lt(heap_[child], heap_[child + 1])) child++;
+      if (!lt(v, heap_[child])) break;
+      heap_[i] = heap_[child];
+      pos_[heap_[i]] = i;
+      i = child;
+    }
+    heap_[i] = v;
+    pos_[v] = i;
+  }
+};
+
+class Solver {
+ public:
+  explicit Solver(int num_vars)
+      : n_(num_vars),
+        assigns_(num_vars, kUndef),
+        phase_(num_vars, kFalse),
+        level_(num_vars, 0),
+        reason_(num_vars, -1),
+        activity_(num_vars, 0.0),
+        seen_(num_vars, 0),
+        watches_(2 * (size_t)num_vars),
+        heap_(num_vars, activity_) {}
+
+  bool ok() const { return ok_; }
+
+  void add_clause(const Lit* lits, int len) {
+    if (!ok_) return;
+    std::vector<Lit> c(lits, lits + len);
+    std::sort(c.begin(), c.end());
+    c.erase(std::unique(c.begin(), c.end()), c.end());
+    for (size_t i = 0; i + 1 < c.size(); ++i)
+      if (c[i] == lit_neg(c[i + 1])) return;  // tautology
+    std::vector<Lit> out;
+    for (Lit l : c) {
+      int8_t v = value(l);
+      if (v == kTrue) return;
+      if (v == kUndef) out.push_back(l);
+    }
+    if (out.empty()) { ok_ = false; return; }
+    if (out.size() == 1) {
+      if (!enqueue(out[0], -1) || propagate() != -1) ok_ = false;
+      return;
+    }
+    attach(out, false, 0);
+  }
+
+  // 10 SAT, 20 UNSAT, 0 unknown
+  int solve(const std::vector<Lit>& assumptions, double timeout_s,
+            int64_t conflict_budget) {
+    if (!ok_) return 20;
+    assumptions_ = assumptions;
+    if (timeout_s > 0)
+      deadline_ = std::chrono::steady_clock::now() +
+                  std::chrono::duration_cast<std::chrono::steady_clock::duration>(
+                      std::chrono::duration<double>(timeout_s));
+    has_deadline_ = timeout_s > 0;
+    int64_t conflicts_total = 0;
+    for (int restart = 0;; ++restart) {
+      int64_t budget = (int64_t)(100 * luby(2.0, restart));
+      int res = search(budget, conflicts_total);
+      if (res != 2) return res;
+      cancel_until(0);
+      if (conflict_budget > 0 && conflicts_total > conflict_budget) return 0;
+      if (has_deadline_ && std::chrono::steady_clock::now() > deadline_) return 0;
+    }
+  }
+
+  int8_t model_value(Var v) const { return assigns_[v]; }
+
+ private:
+  int n_;
+  bool ok_ = true;
+  std::vector<Clause> clauses_;
+  std::vector<int8_t> assigns_, phase_;
+  std::vector<int> level_, reason_;
+  std::vector<double> activity_;
+  std::vector<int8_t> seen_;
+  std::vector<std::vector<Watcher>> watches_;
+  VarHeap heap_;
+  std::vector<Lit> trail_;
+  std::vector<int> trail_lim_;
+  std::vector<Lit> assumptions_;
+  size_t qhead_ = 0;
+  double var_inc_ = 1.0, clause_inc_ = 1.0;
+  int64_t reduce_next_ = 4000;
+  bool has_deadline_ = false;
+  std::chrono::steady_clock::time_point deadline_;
+
+  int8_t value(Lit l) const {
+    int8_t a = assigns_[lit_var(l)];
+    return a == kUndef ? kUndef : (lit_sign(l) ? int8_t(-a) : a);
+  }
+
+  int decision_level() const { return (int)trail_lim_.size(); }
+
+  void attach(const std::vector<Lit>& lits, bool learnt, int lbd) {
+    int idx = (int)clauses_.size();
+    clauses_.push_back({lits, learnt, lbd, clause_inc_});
+    watches_[lit_neg(lits[0])].push_back({idx, lits[1]});
+    watches_[lit_neg(lits[1])].push_back({idx, lits[0]});
+  }
+
+  bool enqueue(Lit l, int reason) {
+    if (value(l) != kUndef) return value(l) == kTrue;
+    Var v = lit_var(l);
+    assigns_[v] = lit_sign(l) ? kFalse : kTrue;
+    phase_[v] = assigns_[v];
+    level_[v] = decision_level();
+    reason_[v] = reason;
+    trail_.push_back(l);
+    return true;
+  }
+
+  int propagate() {
+    while (qhead_ < trail_.size()) {
+      Lit p = trail_[qhead_++];
+      auto& ws = watches_[p];
+      size_t i = 0, j = 0;
+      while (i < ws.size()) {
+        Watcher w = ws[i];
+        if (value(w.blocker) == kTrue) { ws[j++] = ws[i++]; continue; }
+        Clause& c = clauses_[w.clause_idx];
+        Lit false_lit = lit_neg(p);
+        if (c.lits[0] == false_lit) std::swap(c.lits[0], c.lits[1]);
+        Lit first = c.lits[0];
+        if (first != w.blocker && value(first) == kTrue) {
+          ws[j++] = {w.clause_idx, first};
+          i++;
+          continue;
+        }
+        bool found = false;
+        for (size_t k = 2; k < c.lits.size(); ++k) {
+          if (value(c.lits[k]) != kFalse) {
+            std::swap(c.lits[1], c.lits[k]);
+            watches_[lit_neg(c.lits[1])].push_back({w.clause_idx, first});
+            found = true;
+            break;
+          }
+        }
+        if (found) { i++; continue; }
+        ws[j++] = {w.clause_idx, first};
+        i++;
+        if (value(first) == kFalse) {
+          while (i < ws.size()) ws[j++] = ws[i++];
+          ws.resize(j);
+          qhead_ = trail_.size();
+          return w.clause_idx;
+        }
+        enqueue(first, w.clause_idx);
+      }
+      ws.resize(j);
+    }
+    return -1;
+  }
+
+  void bump_var(Var v) {
+    activity_[v] += var_inc_;
+    if (activity_[v] > 1e100) {
+      for (Var u = 0; u < n_; ++u) activity_[u] *= 1e-100;
+      var_inc_ *= 1e-100;
+    }
+    heap_.increased(v);
+  }
+
+  void analyze(int conflict, std::vector<Lit>& learnt, int& bt_level, int& lbd) {
+    learnt.clear();
+    learnt.push_back(0);
+    int counter = 0;
+    Lit p = -1;
+    size_t index = trail_.size();
+    int cidx = conflict;
+    do {
+      Clause& c = clauses_[cidx];
+      if (c.learnt) c.activity += clause_inc_;
+      for (size_t k = (p == -1 ? 0 : 1); k < c.lits.size(); ++k) {
+        Lit q = c.lits[k];
+        Var v = lit_var(q);
+        if (!seen_[v] && level_[v] > 0) {
+          seen_[v] = 1;
+          bump_var(v);
+          if (level_[v] >= decision_level()) counter++;
+          else learnt.push_back(q);
+        }
+      }
+      while (!seen_[lit_var(trail_[--index])]) {}
+      p = trail_[index];
+      cidx = reason_[lit_var(p)];
+      seen_[lit_var(p)] = 0;
+      counter--;
+    } while (counter > 0);
+    learnt[0] = lit_neg(p);
+    // cheap self-subsumption minimization. NOTE: seen_ must be cleared for
+    // ALL original lits (including removed ones) — stale seen_ bits corrupt
+    // every later analyze() and once produced a non-RUP learnt clause.
+    std::vector<Lit> original(learnt);
+    size_t out = 1;
+    for (size_t k = 1; k < learnt.size(); ++k) {
+      int r = reason_[lit_var(learnt[k])];
+      bool redundant = false;
+      if (r != -1) {
+        redundant = true;
+        for (Lit q : clauses_[r].lits)
+          if (q != lit_neg(learnt[k]) && !seen_[lit_var(q)] &&
+              level_[lit_var(q)] > 0) {
+            redundant = false;
+            break;
+          }
+      }
+      if (!redundant) learnt[out++] = learnt[k];
+    }
+    learnt.resize(out);
+    for (Lit q : original) seen_[lit_var(q)] = 0;
+    if (learnt.size() == 1) {
+      bt_level = 0;
+    } else {
+      size_t max_i = 1;
+      for (size_t k = 2; k < learnt.size(); ++k)
+        if (level_[lit_var(learnt[k])] > level_[lit_var(learnt[max_i])]) max_i = k;
+      std::swap(learnt[1], learnt[max_i]);
+      bt_level = level_[lit_var(learnt[1])];
+    }
+    std::vector<int> levels;
+    levels.reserve(learnt.size());
+    for (Lit q : learnt) levels.push_back(level_[lit_var(q)]);
+    std::sort(levels.begin(), levels.end());
+    lbd = (int)(std::unique(levels.begin(), levels.end()) - levels.begin());
+  }
+
+  void cancel_until(int lvl) {
+    if (decision_level() <= lvl) return;
+    for (int i = (int)trail_.size() - 1; i >= trail_lim_[lvl]; --i) {
+      Var v = lit_var(trail_[i]);
+      assigns_[v] = kUndef;
+      reason_[v] = -1;
+      heap_.insert(v);
+    }
+    trail_.resize(trail_lim_[lvl]);
+    trail_lim_.resize(lvl);
+    qhead_ = trail_.size();
+  }
+
+  Var pick_branch() {
+    while (!heap_.empty()) {
+      Var v = heap_.pop_max();
+      if (assigns_[v] == kUndef) return v;
+    }
+    return -1;
+  }
+
+  void reduce_db() {
+    std::vector<int> learnt_idx;
+    for (int i = 0; i < (int)clauses_.size(); ++i)
+      if (clauses_[i].learnt && clauses_[i].lits.size() > 2)
+        learnt_idx.push_back(i);
+    if (learnt_idx.size() < 200) return;
+    std::sort(learnt_idx.begin(), learnt_idx.end(), [&](int a, int b) {
+      if (clauses_[a].lbd != clauses_[b].lbd)
+        return clauses_[a].lbd < clauses_[b].lbd;
+      return clauses_[a].activity > clauses_[b].activity;
+    });
+    std::vector<char> drop(clauses_.size(), 0);
+    for (size_t k = learnt_idx.size() / 2; k < learnt_idx.size(); ++k) {
+      int ci = learnt_idx[k];
+      if (clauses_[ci].lbd <= 3) continue;
+      bool locked = false;
+      for (Lit l : clauses_[ci].lits)
+        if (value(l) == kTrue && reason_[lit_var(l)] == ci) {
+          locked = true;
+          break;
+        }
+      if (!locked) drop[ci] = 1;
+    }
+    for (auto& ws : watches_) {
+      size_t j = 0;
+      for (size_t i = 0; i < ws.size(); ++i)
+        if (!drop[ws[i].clause_idx]) ws[j++] = ws[i];
+      ws.resize(j);
+    }
+    for (size_t ci = 0; ci < clauses_.size(); ++ci)
+      if (drop[ci]) {
+        clauses_[ci].lits.clear();
+        clauses_[ci].lits.shrink_to_fit();
+      }
+  }
+
+  // 2 = restart, else 10/20
+  int search(int64_t conflict_budget, int64_t& conflicts_total) {
+    std::vector<Lit> learnt;
+    int64_t conflicts = 0;
+    for (;;) {
+      int confl = propagate();
+      if (confl != -1) {
+        conflicts++;
+        conflicts_total++;
+        if (decision_level() == 0) return 20;
+        int bt, lbd;
+        analyze(confl, learnt, bt, lbd);
+        cancel_until(bt);
+        if (learnt.size() == 1) {
+          if (!enqueue(learnt[0], -1)) return 20;
+        } else {
+          attach(learnt, true, lbd);
+          enqueue(learnt[0], (int)clauses_.size() - 1);
+        }
+        var_inc_ /= 0.95;
+        clause_inc_ /= 0.999;
+        if (conflicts_total >= reduce_next_) {
+          reduce_db();
+          reduce_next_ += 3000;
+        }
+        if (has_deadline_ && (conflicts_total & 255) == 0 &&
+            std::chrono::steady_clock::now() > deadline_)
+          return 2;  // solve() re-checks the deadline and returns 0
+        if (conflicts >= conflict_budget) return 2;
+      } else {
+        if (decision_level() < (int)assumptions_.size()) {
+          Lit a = assumptions_[decision_level()];
+          if (value(a) == kFalse) return 20;  // conflicts with forced lits
+          trail_lim_.push_back((int)trail_.size());
+          if (value(a) == kUndef) enqueue(a, -1);
+          continue;
+        }
+        Var next = pick_branch();
+        if (next == -1) return 10;
+        trail_lim_.push_back((int)trail_.size());
+        enqueue(mk_lit(next, phase_[next] != kTrue), -1);
+      }
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+int sat_solve(int num_vars, const int* clause_lits,
+              const long long* clause_offsets, int num_clauses,
+              const int* assumptions, int num_assumptions, double timeout_s,
+              long long conflict_budget, signed char* model_out) {
+  Solver solver(num_vars);
+  std::vector<Lit> buf;
+  for (int c = 0; c < num_clauses; ++c) {
+    long long begin = clause_offsets[c], end = clause_offsets[c + 1];
+    buf.clear();
+    for (long long k = begin; k < end; ++k) {
+      int dim = clause_lits[k];
+      buf.push_back(mk_lit(std::abs(dim) - 1, dim < 0));
+    }
+    if (buf.empty()) return 20;
+    solver.add_clause(buf.data(), (int)buf.size());
+    if (!solver.ok()) return 20;
+  }
+  std::vector<Lit> assume;
+  for (int i = 0; i < num_assumptions; ++i) {
+    int dim = assumptions[i];
+    assume.push_back(mk_lit(std::abs(dim) - 1, dim < 0));
+  }
+  int res = solver.solve(assume, timeout_s, conflict_budget);
+  if (res == 10 && model_out) {
+    for (int v = 0; v < num_vars; ++v)
+      model_out[v + 1] = solver.model_value(v) == kTrue ? 1 : 0;
+  }
+  return res;
+}
+}

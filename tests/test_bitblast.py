@@ -1,0 +1,117 @@
+"""Differential tests: AIG circuit simulation vs the concrete term evaluator.
+
+The blaster and the evaluator are independent implementations of the same
+QF_BV semantics; agreement on random vectors is the correctness evidence
+(this environment has no z3 to compare against)."""
+
+import random
+
+from mythril_tpu.smt import terms
+from mythril_tpu.smt.bitblast import Blaster
+from mythril_tpu.smt.eval import evaluate
+
+
+def simulate(blaster, lit, assignment_bits):
+    """Evaluate an AIG literal under {var: bool}; gates are in topo order."""
+    values = dict(assignment_bits)
+    values[0] = False
+    aig = blaster.aig
+
+    def lit_val(literal):
+        return values[literal >> 1] ^ bool(literal & 1)
+
+    for gate_var, (lhs, rhs) in zip(aig.gate_vars, aig.gates):
+        values[gate_var] = lit_val(lhs) and lit_val(rhs)
+    return lit_val(lit)
+
+
+def bits_assignment(blaster, values_by_name):
+    out = {}
+    for name, value in values_by_name.items():
+        for i, var in enumerate(blaster.bv_symbol_vars[name]):
+            out[var] = bool((value >> i) & 1)
+    return out
+
+
+def check_bool(term, names, width, rounds=40, seed=0):
+    rng = random.Random(seed)
+    blaster = Blaster()
+    lit = blaster.assert_bool(term)
+    for _ in range(rounds):
+        vals = {n: rng.randrange(1 << width) for n in names}
+        # bias toward interesting corners
+        if rng.random() < 0.3:
+            vals = {n: rng.choice([0, 1, (1 << width) - 1, 1 << (width - 1)]) for n in names}
+        expected = evaluate(term, vals)
+        got = simulate(blaster, lit, bits_assignment(blaster, vals))
+        assert got == expected, f"{term!r} @ {vals}: circuit={got} eval={expected}"
+
+
+def check_bv(term, names, width, rounds=40, seed=0):
+    rng = random.Random(seed)
+    blaster = Blaster()
+    bits = blaster._bv(term)
+    for _ in range(rounds):
+        vals = {n: rng.randrange(1 << width) for n in names}
+        if rng.random() < 0.3:
+            vals = {n: rng.choice([0, 1, 2, 3, (1 << width) - 1, 1 << (width - 1)]) for n in names}
+        expected = evaluate(term, vals)
+        assignment = bits_assignment(blaster, vals)
+        got = 0
+        for i, bit_lit in enumerate(bits):
+            if simulate(blaster, bit_lit, assignment):
+                got |= 1 << i
+        assert got == expected, f"{term!r} @ {vals}: circuit={got:#x} eval={expected:#x}"
+
+
+W = 8
+A = terms.bv_sym("a", W)
+B = terms.bv_sym("b", W)
+
+
+def test_arithmetic_ops():
+    for op in ("bvadd", "bvsub", "bvmul", "bvudiv", "bvurem", "bvsdiv", "bvsrem"):
+        check_bv(terms.Term(op, (A, B), (), W), ["a", "b"], W, seed=hash(op) & 0xFFFF)
+
+
+def test_bitwise_ops():
+    for op in ("bvand", "bvor", "bvxor"):
+        check_bv(terms.Term(op, (A, B), (), W), ["a", "b"], W)
+    check_bv(terms.bv_not(A), ["a"], W)
+    check_bv(terms.bv_neg(A), ["a"], W)
+
+
+def test_shifts():
+    for op in ("bvshl", "bvlshr", "bvashr"):
+        check_bv(terms.Term(op, (A, B), (), W), ["a", "b"], W, rounds=80, seed=7)
+
+
+def test_comparisons():
+    for op in ("bvult", "bvule", "bvslt", "bvsle"):
+        check_bool(terms.Term(op, (A, B), (), terms.BOOL), ["a", "b"], W, rounds=80)
+    check_bool(terms.eq(A, B), ["a", "b"], W)
+
+
+def test_structure_ops():
+    check_bv(terms.concat([A, B]), ["a", "b"], W)
+    check_bv(terms.extract(5, 2, A), ["a"], W)
+    check_bv(terms.zext(4, A), ["a"], W)
+    check_bv(terms.sext(4, A), ["a"], W)
+    cond = terms.bv_cmp("bvult", A, B)
+    check_bv(terms.ite(cond, A, B), ["a", "b"], W)
+
+
+def test_compound_expression():
+    # (a * b + a) % (b | 1)  -- mixes everything
+    expr = terms.bv_binop(
+        "bvurem",
+        terms.bv_binop("bvadd", terms.bv_binop("bvmul", A, B), A),
+        terms.bv_binop("bvor", B, terms.bv_val(1, W)),
+    )
+    check_bv(expr, ["a", "b"], W, rounds=60)
+
+
+def test_division_by_zero_is_evm_zero():
+    zero = terms.bv_val(0, W)
+    for op in ("bvudiv", "bvurem", "bvsdiv", "bvsrem"):
+        check_bv(terms.Term(op, (A, zero), (), W), ["a"], W, rounds=10)
