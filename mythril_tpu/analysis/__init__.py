@@ -1,0 +1,1 @@
+"""Analysis layer: detection modules, solver helpers, reports."""

@@ -1,0 +1,101 @@
+"""Two-phase issue confirmation (reference analysis/potential_issues.py:126).
+
+Modules record PotentialIssues (predicate constraints, no tx model yet) in a
+state annotation; at transaction end check_potential_issues re-solves
+world_constraints + issue constraints and promotes survivors to Issues with
+a concrete transaction sequence."""
+
+import logging
+from typing import List
+
+from mythril_tpu.laser.state.annotation import StateAnnotation
+from mythril_tpu.smt.solver.frontend import SolverTimeOutException, UnsatError
+
+log = logging.getLogger(__name__)
+
+
+class PotentialIssue:
+    def __init__(
+        self,
+        contract,
+        function_name,
+        address,
+        swc_id,
+        title,
+        bytecode,
+        detector,
+        severity,
+        description_head,
+        description_tail,
+        constraints=None,
+    ):
+        self.title = title
+        self.contract = contract
+        self.function_name = function_name
+        self.address = address
+        self.description_head = description_head
+        self.description_tail = description_tail
+        self.severity = severity
+        self.swc_id = swc_id
+        self.bytecode = bytecode
+        self.constraints = constraints or []
+        self.detector = detector
+
+
+class PotentialIssuesAnnotation(StateAnnotation):
+    def __init__(self):
+        self.potential_issues: List[PotentialIssue] = []
+
+    @property
+    def search_importance(self):
+        return 10 * len(self.potential_issues)
+
+    def clone(self):
+        # shared across the path tree on purpose: potential issues found on
+        # one branch are checked when any descendant transaction ends
+        return self
+
+
+def get_potential_issues_annotation(global_state) -> PotentialIssuesAnnotation:
+    for annotation in global_state.annotations:
+        if isinstance(annotation, PotentialIssuesAnnotation):
+            return annotation
+    annotation = PotentialIssuesAnnotation()
+    global_state.annotate(annotation)
+    return annotation
+
+
+def check_potential_issues(global_state) -> None:
+    """Called at transaction end (engine svm._end_transaction)."""
+    annotation = get_potential_issues_annotation(global_state)
+    unsatisfied = []
+    for potential_issue in annotation.potential_issues:
+        try:
+            from mythril_tpu.analysis.solver import get_transaction_sequence
+
+            transaction_sequence = get_transaction_sequence(
+                global_state,
+                global_state.world_state.constraints + potential_issue.constraints,
+            )
+        except (UnsatError, SolverTimeOutException):
+            # keep it: constraints may become satisfiable after a later
+            # transaction mutates state (reference potential_issues.py:97-99)
+            unsatisfied.append(potential_issue)
+            continue
+        from mythril_tpu.analysis.report import Issue
+
+        issue = Issue(
+            contract=potential_issue.contract,
+            function_name=potential_issue.function_name,
+            address=potential_issue.address,
+            title=potential_issue.title,
+            bytecode=potential_issue.bytecode,
+            swc_id=potential_issue.swc_id,
+            severity=potential_issue.severity,
+            description_head=potential_issue.description_head,
+            description_tail=potential_issue.description_tail,
+            transaction_sequence=transaction_sequence,
+        )
+        potential_issue.detector.issues.append(issue)
+        potential_issue.detector.update_cache([issue])
+    annotation.potential_issues = unsatisfied

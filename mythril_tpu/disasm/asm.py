@@ -67,23 +67,39 @@ def instrs_to_easm(instrs: List[Instr]) -> str:
 
 
 _EASM_LINE = re.compile(
-    r"^(?:(\d+)\s+)?([A-Z][A-Z0-9]*|UNKNOWN_0x[0-9a-fA-F]{2})(?:\s+0x([0-9a-fA-F]+))?$"
+    r"^(?:(\d+)\s+)?([A-Z][A-Z0-9]*|UNKNOWN_0x[0-9a-fA-F]{2})"
+    r"(?:\s+(0x[0-9a-fA-F]+|@[A-Za-z_][A-Za-z0-9_]*))?$"
 )
+_LABEL_LINE = re.compile(r"^:([A-Za-z_][A-Za-z0-9_]*)$")
 
 
 def easm_to_code(easm: str) -> bytes:
-    """Assemble EASM text back to bytecode (used by tests and the assembler)."""
+    """Assemble EASM text to bytecode.
+
+    Supports labels to avoid hand-counted jump offsets:
+        :loop           defines a label at the next instruction
+        PUSH2 @loop     references it (operand patched after layout)
+    """
     blob = bytearray()
+    labels = {}
+    fixups = []  # (offset, width, label_name, source_line)
     for line in easm.splitlines():
         line = line.strip()
         if not line or line.startswith("#"):
             continue
+        label_match = _LABEL_LINE.match(line)
+        if label_match:
+            name = label_match.group(1)
+            if name in labels:
+                raise ValueError(f"duplicate label :{name}")
+            labels[name] = len(blob)
+            continue
         match = _EASM_LINE.match(line)
         if not match:
             raise ValueError(f"cannot parse EASM line: {line!r}")
-        _, mnemonic, arg_hex = match.groups()
+        _, mnemonic, arg = match.groups()
         if mnemonic.startswith("UNKNOWN_0x"):
-            if arg_hex is not None:
+            if arg is not None:
                 raise ValueError(f"{mnemonic} takes no operand: {line!r}")
             blob.append(int(mnemonic[10:], 16))
             continue
@@ -93,14 +109,27 @@ def easm_to_code(easm: str) -> bytes:
         blob.append(spec.byte)
         width = opcodes.push_width(mnemonic)
         if width:
-            if arg_hex is None:
+            if arg is None:
                 raise ValueError(f"{mnemonic} needs an operand")
-            try:
-                blob += int(arg_hex, 16).to_bytes(width, "big")
-            except OverflowError:
-                raise ValueError(
-                    f"operand 0x{arg_hex} does not fit {mnemonic}: {line!r}"
-                ) from None
-        elif arg_hex is not None:
+            if arg.startswith("@"):
+                fixups.append((len(blob), width, arg[1:], line))
+                blob += b"\x00" * width
+            else:
+                try:
+                    blob += int(arg, 16).to_bytes(width, "big")
+                except OverflowError:
+                    raise ValueError(
+                        f"operand {arg} does not fit {mnemonic}: {line!r}"
+                    ) from None
+        elif arg is not None:
             raise ValueError(f"{mnemonic} takes no operand: {line!r}")
+    for offset, width, name, line in fixups:
+        if name not in labels:
+            raise ValueError(f"undefined label @{name}: {line!r}")
+        try:
+            blob[offset:offset + width] = labels[name].to_bytes(width, "big")
+        except OverflowError:
+            raise ValueError(
+                f"label @{name}={labels[name]} does not fit: {line!r}"
+            ) from None
     return bytes(blob)

@@ -1,0 +1,1 @@
+"""LASER: the symbolic EVM engine (worklist interpreter over SMT state)."""

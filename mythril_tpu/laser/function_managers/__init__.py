@@ -1,0 +1,6 @@
+from mythril_tpu.laser.function_managers.keccak import (  # noqa: F401
+    keccak_function_manager,
+)
+from mythril_tpu.laser.function_managers.exponent import (  # noqa: F401
+    exponent_function_manager,
+)

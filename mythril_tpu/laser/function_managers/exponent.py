@@ -1,0 +1,43 @@
+"""Symbolic EXP modeling (reference
+mythril/laser/ethereum/function_managers/exponent_function_manager.py:71).
+
+Concrete base+exponent folds natively. Otherwise EXP becomes an
+uninterpreted function exp(base, exponent) with interpolation constraints
+for small concrete bases (2, 10, 256) tying sampled powers down."""
+
+from typing import List, Tuple
+
+from mythril_tpu.smt import And, BitVec, Bool, Function, symbol_factory
+
+
+class ExponentFunctionManager:
+    def __init__(self):
+        self.exponentiation = Function("exponentiation", [256, 256], 256)
+        self.concrete_constraints: List[Bool] = []
+
+    def reset(self):
+        self.__init__()
+
+    def create_condition(self, base: BitVec, exponent: BitVec) -> Tuple[BitVec, Bool]:
+        """Returns (power_expr, side_constraint)."""
+        if not base.symbolic and not exponent.symbolic:
+            value = pow(base.concrete_value, exponent.concrete_value, 2 ** 256)
+            return symbol_factory.BitVecVal(value, 256), Bool.value(True)
+        power = self.exponentiation(base, exponent)
+        if not base.symbolic and base.concrete_value in (2, 10, 256):
+            base_value = base.concrete_value
+            constraints = []
+            exponent_bits = 256 if base_value == 2 else (77 if base_value == 10 else 32)
+            for sample in range(0, exponent_bits, max(1, exponent_bits // 16)):
+                constraints.append(
+                    Bool.value(True)
+                    if sample == 0
+                    else (exponent == sample)
+                    == (power == pow(base_value, sample, 2 ** 256))
+                )
+            condition = And(*constraints) if constraints else Bool.value(True)
+            return power, condition
+        return power, Bool.value(True)
+
+
+exponent_function_manager = ExponentFunctionManager()

@@ -1,0 +1,7 @@
+from mythril_tpu.laser.plugin.signals import (  # noqa: F401
+    PluginSignal,
+    PluginSkipState,
+    PluginSkipWorldState,
+)
+from mythril_tpu.laser.plugin.interface import LaserPlugin, PluginBuilder  # noqa: F401
+from mythril_tpu.laser.plugin.loader import LaserPluginLoader  # noqa: F401

@@ -1,0 +1,1 @@
+"""Symbolic machine/world state objects carried by every explored path."""

@@ -1,0 +1,89 @@
+"""Machine frame: stack / memory / pc / gas
+(reference laser/ethereum/state/machine_state.py:263)."""
+
+from typing import List
+
+from mythril_tpu.laser.evm_exceptions import StackOverflowException, StackUnderflowException
+from mythril_tpu.laser.state.memory import Memory
+
+STACK_LIMIT = 1024
+
+
+class MachineStack(list):
+    def append(self, element) -> None:
+        if len(self) >= STACK_LIMIT:
+            raise StackOverflowException(
+                f"stack limit {STACK_LIMIT} reached"
+            )
+        super().append(element)
+
+    def pop(self, index=-1):
+        try:
+            return super().pop(index)
+        except IndexError:
+            raise StackUnderflowException("pop from empty stack") from None
+
+
+class MachineState:
+    def __init__(
+        self,
+        gas_limit: int,
+        pc: int = 0,
+        stack=None,
+        subroutine_stack=None,
+        memory: Memory = None,
+        depth: int = 0,
+        max_gas_used: int = 0,
+        min_gas_used: int = 0,
+    ):
+        self.gas_limit = gas_limit
+        self.pc = pc
+        self.stack = MachineStack(stack or [])
+        self.subroutine_stack = MachineStack(subroutine_stack or [])
+        self.memory = memory or Memory()
+        self.depth = depth
+        self.max_gas_used = max_gas_used
+        self.min_gas_used = min_gas_used
+
+    def check_gas(self) -> None:
+        from mythril_tpu.laser.evm_exceptions import OutOfGasException
+
+        if self.min_gas_used > self.gas_limit:
+            raise OutOfGasException()
+
+    @property
+    def memory_size(self) -> int:
+        return self.memory.size
+
+    def mem_extend(self, start, size) -> None:
+        """Grow memory (concrete bounds only; symbolic bounds left unexpanded)."""
+        if isinstance(start, int) and isinstance(size, int):
+            self.memory.extend_to(start, size)
+
+    def pop(self, amount: int = 1):
+        values = [self.stack.pop() for _ in range(amount)]
+        return values[0] if amount == 1 else values
+
+    def clone(self) -> "MachineState":
+        dup = MachineState.__new__(MachineState)
+        dup.gas_limit = self.gas_limit
+        dup.pc = self.pc
+        dup.stack = MachineStack(self.stack)
+        dup.subroutine_stack = MachineStack(self.subroutine_stack)
+        dup.memory = self.memory.clone()
+        dup.depth = self.depth
+        dup.max_gas_used = self.max_gas_used
+        dup.min_gas_used = self.min_gas_used
+        return dup
+
+    def __deepcopy__(self, memo):
+        return self.clone()
+
+    def as_dict(self):
+        return {
+            "pc": self.pc,
+            "stack": list(self.stack),
+            "memory": self.memory,
+            "memsize": self.memory_size,
+            "gas": self.gas_limit - self.max_gas_used,
+        }

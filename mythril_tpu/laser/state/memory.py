@@ -1,0 +1,117 @@
+"""Symbolic byte-addressed EVM memory.
+
+Reference (laser/ethereum/state/memory.py) keeps a dict of byte cells; here
+memory is a functional SMT array (256-bit index -> 8-bit cells). The term
+layer's eager read-over-write elimination makes concrete-index access fold
+away, and symbolic-index access is handled by the solver's store-chain
+unwinding — one mechanism instead of two."""
+
+from typing import List, Union
+
+from mythril_tpu.smt import BitVec, Concat, Extract, If, symbol_factory
+from mythril_tpu.smt.array_expr import K
+from mythril_tpu.smt import terms as _terms
+
+APPROX_ITR = 100  # cap for symbolic-length copy loops (reference memory.py:30)
+
+
+def _to_index(index) -> BitVec:
+    if isinstance(index, int):
+        return symbol_factory.BitVecVal(index, 256)
+    return index
+
+
+class Memory:
+    def __init__(self):
+        self._memory = K(256, 8, 0)
+        self._msize = 0
+
+    @property
+    def size(self) -> int:
+        return self._msize
+
+    def extend(self, size: int) -> None:
+        self._msize += size
+
+    def extend_to(self, offset: int, length: int) -> None:
+        """Word-aligned growth covering [offset, offset+length)."""
+        if length == 0:
+            return
+        needed = ((offset + length + 31) // 32) * 32
+        if needed > self._msize:
+            self._msize = needed
+
+    def __getitem__(self, item) -> Union[BitVec, List[BitVec]]:
+        if isinstance(item, slice):
+            start = item.start or 0
+            stop = item.stop
+            step = item.step or 1
+            assert step == 1 and stop is not None, "memory slices must be contiguous"
+            return [self.get_byte(i) for i in range(start, stop)]
+        return self.get_byte(item)
+
+    def __setitem__(self, key, value) -> None:
+        if isinstance(key, slice):
+            start = key.start or 0
+            assert (key.step or 1) == 1
+            for offset, byte in enumerate(value):
+                self.write_byte(start + offset, byte)
+        else:
+            self.write_byte(key, value)
+
+    def get_byte(self, index) -> BitVec:
+        return self._memory[_to_index(index)]
+
+    def write_byte(self, index, value) -> None:
+        if isinstance(value, int):
+            value = symbol_factory.BitVecVal(value, 8)
+        elif value.size != 8:
+            value = Extract(7, 0, value)
+        self._memory[_to_index(index)] = value
+
+    def get_word_at(self, index) -> BitVec:
+        """Big-endian 32-byte word starting at `index`."""
+        if isinstance(index, int):
+            parts = [self.get_byte(index + i) for i in range(32)]
+        else:
+            parts = [
+                self.get_byte(index + symbol_factory.BitVecVal(i, 256))
+                for i in range(32)
+            ]
+        return Concat(parts)
+
+    def write_word_at(self, index, value) -> None:
+        if isinstance(value, int):
+            value = symbol_factory.BitVecVal(value, 256)
+        elif isinstance(value, bool):
+            value = If(
+                value,
+                symbol_factory.BitVecVal(1, 256),
+                symbol_factory.BitVecVal(0, 256),
+            )
+        if value.size < 256:
+            from mythril_tpu.smt import ZeroExt
+
+            value = ZeroExt(256 - value.size, value)
+        for i in range(32):
+            byte = Extract(255 - 8 * i, 248 - 8 * i, value)
+            if isinstance(index, int):
+                self.write_byte(index + i, byte)
+            else:
+                self.write_byte(index + symbol_factory.BitVecVal(i, 256), byte)
+
+    def copy_from_bytes(self, offset, data: bytes) -> None:
+        for i, byte in enumerate(data):
+            self.write_byte(offset + i, byte)
+
+    def read_bytes_concrete(self, offset: int, length: int) -> List[BitVec]:
+        return [self.get_byte(offset + i) for i in range(length)]
+
+    def clone(self) -> "Memory":
+        dup = Memory.__new__(Memory)
+        dup._memory = self._memory.clone()
+        dup._msize = self._msize
+        return dup
+
+    def __deepcopy__(self, memo) -> "Memory":
+        return self.clone()

@@ -1,0 +1,48 @@
+"""Concrete-value transaction setup (reference
+laser/ethereum/transaction/concolic.py:172).
+
+Used by the VMTests-style conformance harness and concolic mode: all tx
+fields (caller, calldata, value, gas) are concrete."""
+
+from typing import List, Optional
+
+from mythril_tpu.laser.state.calldata import BasicConcreteCalldata
+from mythril_tpu.laser.transaction.models import MessageCallTransaction
+from mythril_tpu.smt import symbol_factory
+
+
+def execute_transaction(
+    laser_evm,
+    callee_address,
+    caller_address,
+    data: Optional[List[int]] = None,
+    gas_price: int = 10,
+    gas_limit: int = 8_000_000,
+    value: int = 0,
+    track_gas: bool = False,
+) -> None:
+    """Seed and run one concrete message call on every open world state."""
+    if isinstance(callee_address, int):
+        callee_address = symbol_factory.BitVecVal(callee_address, 256)
+    if isinstance(caller_address, int):
+        caller_address = symbol_factory.BitVecVal(caller_address, 256)
+    open_states = laser_evm.open_states[:]
+    del laser_evm.open_states[:]
+    for world_state in open_states:
+        callee_account = world_state.accounts_exist_or_load(callee_address)
+        transaction = MessageCallTransaction(
+            world_state=world_state,
+            callee_account=callee_account,
+            caller=caller_address,
+            call_data=BasicConcreteCalldata("concrete", list(data or [])),
+            gas_price=symbol_factory.BitVecVal(gas_price, 256),
+            gas_limit=gas_limit,
+            origin=caller_address,
+            call_value=symbol_factory.BitVecVal(value, 256),
+        )
+        from mythril_tpu.laser.transaction.symbolic import (
+            _setup_global_state_for_execution,
+        )
+
+        _setup_global_state_for_execution(laser_evm, transaction)
+    laser_evm.exec(track_gas=track_gas)

@@ -19,6 +19,7 @@ BVAddNoOverflow/BVMulNoOverflow/BVSubNoUnderflow, is_true/is_false).
 """
 
 from mythril_tpu.smt.bitvec import (  # noqa: F401
+    AShR,
     BitVec,
     BVAddNoOverflow,
     BVMulNoOverflow,

@@ -163,7 +163,7 @@ class _Prepared:
     """Lowered + blasted problem state shared across assumption probes."""
 
     __slots__ = ("trivial", "original", "lowering", "blaster",
-                 "num_vars", "clauses", "objective_bits")
+                 "num_vars", "clauses", "objective_bits", "last_bits")
 
     def __init__(self):
         self.trivial: Optional[str] = None
@@ -173,6 +173,7 @@ class _Prepared:
         self.num_vars = 0
         self.clauses: List = []
         self.objective_bits: List[List[int]] = []
+        self.last_bits: Optional[List[bool]] = None
 
 
 class Solver:
@@ -257,6 +258,7 @@ class Solver:
             conflict_budget=self.conflict_budget,
         )
         if status == SAT:
+            prep.last_bits = bits
             self._model = self._reconstruct(
                 prep.blaster, bits, prep.lowering, prep.original
             )
@@ -367,6 +369,14 @@ class Optimize(Solver):
                 continue  # constant bit: nothing to decide
             dimacs = -var if aig_lit & 1 else var
             trial = -dimacs if prefer_negative else dimacs
+            # witnessed-bit skip: if the current model already has this bit at
+            # the preferred value, it witnesses SAT of (assumptions + trial) —
+            # adopt the assumption without a solver call
+            if prep.last_bits is not None:
+                bit_value = prep.last_bits[var] ^ bool(aig_lit & 1)
+                if bit_value == (not prefer_negative):
+                    assumptions.append(trial)
+                    continue
             saved = self.timeout
             self.timeout = max(0.25, deadline - time.monotonic())
             try:

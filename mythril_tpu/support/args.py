@@ -1,0 +1,37 @@
+"""Process-global analysis flags (reference mythril/support/support_args.py:31).
+
+Populated by the analyzer frontend from CLI flags; read by the engine,
+plugins, detection modules, and solver glue."""
+
+
+class _Args:
+    def __init__(self):
+        self.solver_timeout = 25000            # ms per query
+        self.execution_timeout = 86400         # s per contract
+        self.create_timeout = 10               # s for creation tx
+        self.max_depth = 128
+        self.loop_bound = 3
+        self.transaction_count = 2
+        self.pruning_factor = None             # None -> auto
+        self.unconstrained_storage = False
+        self.parallel_solving = False
+        self.call_depth_limit = 3
+        self.iteration_count = 0
+        self.solver_log = None
+        self.sparse_pruning = False
+        self.incremental_txs = True
+        self.use_issue_annotations = False
+        self.use_integer_module = True
+        self.disable_dependency_pruning = False
+        self.disable_mutation_pruner = False
+        self.disable_coverage_strategy = False
+        self.disable_iprof = False
+        self.enable_state_merging = False
+        self.enable_summaries = False
+        self.solver_backend = "cpu"            # cpu | tpu (shadowed by cpu)
+
+    def reset(self):
+        self.__init__()
+
+
+args = _Args()

@@ -1,0 +1,122 @@
+"""The central solve path (reference mythril/support/model.py:63-125).
+
+get_model(constraints, ...) is the single choke point every reachability
+check and exploit concretization goes through:
+
+  model cache -> quick-sat probe over recent models -> full solve with a
+  deadline capped by the global time budget -> cache the model.
+
+raises UnsatError on unsat, SolverTimeOutException on unknown.
+This is also the designed backend seam: `args.solver_backend` selects the
+batched TPU solver for eligible queries (with the CPU CDCL as oracle).
+"""
+
+from collections import OrderedDict, deque
+from typing import Iterable, List, Optional
+
+from mythril_tpu.smt.bitvec import Expression
+from mythril_tpu.smt.model import Model
+from mythril_tpu.smt.solver import Optimize, Solver
+from mythril_tpu.smt.solver.frontend import (
+    SAT,
+    UNSAT,
+    SolverTimeOutException,
+    UnsatError,
+)
+from mythril_tpu.support.args import args
+from mythril_tpu.support.time_handler import time_handler
+
+
+class ModelCache:
+    """Recent models probed before any real solve
+    (reference support_utils.py:57-68)."""
+
+    def __init__(self, maxlen: int = 100):
+        self.models = deque(maxlen=maxlen)
+
+    def check_quick_sat(self, constraints) -> Optional[Model]:
+        for model in self.models:
+            if model.satisfies(constraints):
+                return model
+        return None
+
+    def put(self, model: Model) -> None:
+        self.models.appendleft(model)
+
+
+model_cache = ModelCache()
+
+_result_cache: "OrderedDict" = OrderedDict()
+_RESULT_CACHE_MAX = 2 ** 16
+
+
+def _cache_key(terms_list) -> Optional[tuple]:
+    try:
+        return tuple(sorted(hash(t) for t in terms_list))
+    except TypeError:
+        return None
+
+
+def get_model(
+    constraints,
+    minimize: Iterable = (),
+    maximize: Iterable = (),
+    enforce_execution_time: bool = True,
+    solver_timeout: Optional[int] = None,
+) -> Model:
+    """Solve `constraints` (list of Bool); returns a validated Model."""
+    minimize, maximize = tuple(minimize), tuple(maximize)
+    raw_constraints: List = [
+        c.raw if isinstance(c, Expression) else c for c in constraints
+    ]
+
+    timeout_ms = solver_timeout if solver_timeout is not None else args.solver_timeout
+    timeout_s = timeout_ms / 1000.0
+    if enforce_execution_time:
+        timeout_s = min(timeout_s, max(time_handler.time_remaining() - 0.5, 0.05))
+
+    key = None
+    if not minimize and not maximize:
+        key = _cache_key(raw_constraints)
+        if key is not None and key in _result_cache:
+            cached = _result_cache[key]
+            if isinstance(cached, Model):
+                return cached
+            raise UnsatError()
+        quick = model_cache.check_quick_sat(raw_constraints)
+        if quick is not None:
+            return quick
+
+    if minimize or maximize:
+        solver: Solver = Optimize(timeout=timeout_s)
+        for m in minimize:
+            solver.minimize(m.raw if isinstance(m, Expression) else m)
+        for m in maximize:
+            solver.maximize(m.raw if isinstance(m, Expression) else m)
+    else:
+        solver = Solver(timeout=timeout_s)
+    solver.add(raw_constraints)
+
+    status = solver.check()
+    if status == SAT:
+        model = solver.model()
+        if key is not None:
+            _store_result(key, model)
+            model_cache.put(model)
+        return model
+    if status == UNSAT:
+        if key is not None:
+            _store_result(key, UNSAT)
+        raise UnsatError()
+    raise SolverTimeOutException()
+
+
+def _store_result(key, value) -> None:
+    _result_cache[key] = value
+    while len(_result_cache) > _RESULT_CACHE_MAX:
+        _result_cache.popitem(last=False)
+
+
+def clear_caches() -> None:
+    _result_cache.clear()
+    model_cache.models.clear()

@@ -1,0 +1,23 @@
+"""Singleton wall-clock budget shared by engine and solver
+(reference mythril/laser/ethereum/time_handler.py:19)."""
+
+import time
+
+
+class _TimeHandler:
+    def __init__(self):
+        self._start = None
+        self._timeout = None
+
+    def start_execution(self, execution_timeout_seconds) -> None:
+        self._start = time.monotonic()
+        self._timeout = execution_timeout_seconds or 0
+
+    def time_remaining(self) -> float:
+        """Seconds left in the budget; large if no budget started."""
+        if self._start is None or not self._timeout:
+            return 1e9
+        return self._timeout - (time.monotonic() - self._start)
+
+
+time_handler = _TimeHandler()

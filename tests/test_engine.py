@@ -1,0 +1,393 @@
+"""Engine correctness tests: concrete programs with known results
+(VMTests-style, reference tests/laser/evm_testsuite pattern) plus symbolic
+exploration behavior."""
+
+import pytest
+
+from mythril_tpu.disasm import Disassembly
+from mythril_tpu.disasm.asm import easm_to_code
+from mythril_tpu.laser.svm import LaserEVM
+from mythril_tpu.laser.state.world_state import WorldState
+from mythril_tpu.laser.transaction.concolic import execute_transaction
+from mythril_tpu.smt import symbol_factory
+
+CONTRACT_ADDR = 0x1234
+CALLER_ADDR = 0xCAFE
+
+
+def run_concrete(easm: str, calldata=b"", value=0, storage_pre=None):
+    """Deploy runtime code and run one concrete tx; returns final account."""
+    code = easm_to_code(easm)
+    ws = WorldState()
+    acct = ws.create_account(
+        address=CONTRACT_ADDR, concrete_storage=True, code=Disassembly(code)
+    )
+    if storage_pre:
+        for slot, val in storage_pre.items():
+            acct.storage[symbol_factory.BitVecVal(slot, 256)] = val
+    laser = LaserEVM(transaction_count=1, execution_timeout=60,
+                     requires_statespace=False)
+    laser.open_states = [ws]
+    execute_transaction(
+        laser, CONTRACT_ADDR, CALLER_ADDR, data=list(calldata), value=value
+    )
+    assert laser.open_states, "transaction did not complete successfully"
+    return laser.open_states[0].accounts[CONTRACT_ADDR]
+
+
+def storage_value(account, slot: int) -> int:
+    value = account.storage[symbol_factory.BitVecVal(slot, 256)]
+    return value.concrete_value
+
+
+def test_arithmetic_program():
+    # ((7 + 3) * 6 - 4) / 2 = 28
+    acct = run_concrete("""
+        PUSH1 0x03
+        PUSH1 0x07
+        ADD
+        PUSH1 0x06
+        MUL
+        PUSH1 0x04
+        SWAP1
+        SUB
+        PUSH1 0x02
+        SWAP1
+        DIV
+        PUSH1 0x00
+        SSTORE
+        STOP
+    """)
+    assert storage_value(acct, 0) == 28
+
+
+def test_signed_ops():
+    # -8 / 2 = -4 (SDIV with two's complement)
+    acct = run_concrete("""
+        PUSH1 0x02
+        PUSH32 0xfffffffffffffffffffffffffffffffffffffffffffffffffffffffffffffff8
+        SDIV
+        PUSH1 0x00
+        SSTORE
+        STOP
+    """)
+    assert storage_value(acct, 0) == (2**256 - 4)
+
+
+def test_mulmod_wide_intermediate():
+    # (2^255 * 4) % 7 — intermediate exceeds 256 bits
+    expected = ((2**255) * 4) % 7
+    acct = run_concrete("""
+        PUSH1 0x07
+        PUSH1 0x04
+        PUSH32 0x8000000000000000000000000000000000000000000000000000000000000000
+        MULMOD
+        PUSH1 0x00
+        SSTORE
+        STOP
+    """)
+    assert storage_value(acct, 0) == expected
+
+
+def test_memory_roundtrip():
+    acct = run_concrete("""
+        PUSH32 0xdeadbeefcafebabe112233445566778899aabbccddeeff001122334455667788
+        PUSH1 0x40
+        MSTORE
+        PUSH1 0x40
+        MLOAD
+        PUSH1 0x01
+        SSTORE
+        STOP
+    """)
+    assert storage_value(acct, 1) == int(
+        "deadbeefcafebabe112233445566778899aabbccddeeff001122334455667788", 16
+    )
+
+
+def test_calldataload_concrete():
+    data = bytes.fromhex("a9059cbb") + (42).to_bytes(32, "big")
+    acct = run_concrete("""
+        PUSH1 0x04
+        CALLDATALOAD
+        PUSH1 0x00
+        SSTORE
+        CALLDATASIZE
+        PUSH1 0x01
+        SSTORE
+        STOP
+    """, calldata=data)
+    assert storage_value(acct, 0) == 42
+    assert storage_value(acct, 1) == 36
+
+
+def test_sha3_concrete():
+    from mythril_tpu.utils.keccak import keccak256
+
+    acct = run_concrete("""
+        PUSH1 0x2a
+        PUSH1 0x00
+        MSTORE
+        PUSH1 0x20
+        PUSH1 0x00
+        SHA3
+        PUSH1 0x00
+        SSTORE
+        STOP
+    """)
+    expected = int.from_bytes(keccak256((42).to_bytes(32, "big")), "big")
+    assert storage_value(acct, 0) == expected
+
+
+def test_caller_and_value():
+    acct = run_concrete("""
+        CALLER
+        PUSH1 0x00
+        SSTORE
+        CALLVALUE
+        PUSH1 0x01
+        SSTORE
+        STOP
+    """, value=7)
+    assert storage_value(acct, 0) == CALLER_ADDR
+    assert storage_value(acct, 1) == 7
+
+
+def test_storage_prestate_and_jump():
+    acct = run_concrete("""
+        PUSH1 0x05
+        SLOAD
+        PUSH1 0x08
+        JUMP
+        STOP
+        UNKNOWN_0xfc
+        JUMPDEST
+        PUSH1 0x01
+        ADD
+        PUSH1 0x05
+        SSTORE
+        STOP
+    """, storage_pre={5: 99})
+    assert storage_value(acct, 5) == 100
+
+
+def test_revert_discards_open_state():
+    code = easm_to_code("""
+        PUSH1 0x00
+        PUSH1 0x00
+        REVERT
+    """)
+    ws = WorldState()
+    ws.create_account(address=CONTRACT_ADDR, concrete_storage=True,
+                      code=Disassembly(code))
+    laser = LaserEVM(transaction_count=1, requires_statespace=False)
+    laser.open_states = [ws]
+    execute_transaction(laser, CONTRACT_ADDR, CALLER_ADDR)
+    assert laser.open_states == []
+
+
+def test_shift_ops():
+    acct = run_concrete("""
+        PUSH1 0xff
+        PUSH1 0x04
+        SHL
+        PUSH1 0x00
+        SSTORE
+        PUSH1 0xf0
+        PUSH1 0x04
+        SHR
+        PUSH1 0x01
+        SSTORE
+        STOP
+    """)
+    assert storage_value(acct, 0) == 0xFF0
+    assert storage_value(acct, 1) == 0x0F
+
+
+def test_transient_storage():
+    acct = run_concrete("""
+        PUSH1 0x2a
+        PUSH1 0x07
+        TSTORE
+        PUSH1 0x07
+        TLOAD
+        PUSH1 0x00
+        SSTORE
+        STOP
+    """)
+    assert storage_value(acct, 0) == 42
+
+
+def test_nested_call_and_revert_isolation():
+    """Contract B reverts after SSTORE; A's state must survive untouched."""
+    b_code = easm_to_code("""
+        PUSH1 0x63
+        PUSH1 0x00
+        SSTORE
+        PUSH1 0x00
+        PUSH1 0x00
+        REVERT
+    """)
+    # A: sstore(1, 0x11); call B; sstore(2, retval)
+    a_easm = f"""
+        PUSH1 0x11
+        PUSH1 0x01
+        SSTORE
+        PUSH1 0x00
+        PUSH1 0x00
+        PUSH1 0x00
+        PUSH1 0x00
+        PUSH1 0x00
+        PUSH2 0xb0b0
+        PUSH2 0xffff
+        CALL
+        PUSH1 0x02
+        SSTORE
+        STOP
+    """
+    ws = WorldState()
+    ws.create_account(address=CONTRACT_ADDR, concrete_storage=True,
+                      code=Disassembly(easm_to_code(a_easm)))
+    ws.create_account(address=0xB0B0, concrete_storage=True,
+                      code=Disassembly(b_code))
+    laser = LaserEVM(transaction_count=1, requires_statespace=False)
+    laser.open_states = [ws]
+    execute_transaction(laser, CONTRACT_ADDR, CALLER_ADDR)
+    assert laser.open_states
+    final = laser.open_states[0]
+    a = final.accounts[CONTRACT_ADDR]
+    b = final.accounts[0xB0B0]
+    assert storage_value(a, 1) == 0x11
+    assert storage_value(a, 2) == 0  # call returned 0 (revert)
+    assert storage_value(b, 0) == 0  # B's write rolled back
+
+
+def test_nested_call_success_propagates():
+    b_code = easm_to_code("""
+        PUSH1 0x63
+        PUSH1 0x00
+        SSTORE
+        STOP
+    """)
+    a_easm = """
+        PUSH1 0x00
+        PUSH1 0x00
+        PUSH1 0x00
+        PUSH1 0x00
+        PUSH1 0x00
+        PUSH2 0xb0b0
+        PUSH2 0xffff
+        CALL
+        PUSH1 0x02
+        SSTORE
+        STOP
+    """
+    ws = WorldState()
+    ws.create_account(address=CONTRACT_ADDR, concrete_storage=True,
+                      code=Disassembly(easm_to_code(a_easm)))
+    ws.create_account(address=0xB0B0, concrete_storage=True,
+                      code=Disassembly(b_code))
+    laser = LaserEVM(transaction_count=1, requires_statespace=False)
+    laser.open_states = [ws]
+    execute_transaction(laser, CONTRACT_ADDR, CALLER_ADDR)
+    assert laser.open_states
+    final = laser.open_states[0]
+    assert storage_value(final.accounts[0xB0B0], 0) == 0x63
+    assert storage_value(final.accounts[CONTRACT_ADDR], 2) == 1
+
+
+def test_symbolic_fork_explores_both_sides():
+    from mythril_tpu.laser.transaction.symbolic import execute_message_call
+
+    code = easm_to_code("""
+        PUSH1 0x00
+        CALLDATALOAD
+        PUSH1 0x08
+        JUMPI
+        STOP
+        UNKNOWN_0xfc
+        JUMPDEST
+        PUSH1 0x01
+        PUSH1 0x00
+        SSTORE
+        STOP
+    """)
+    ws = WorldState()
+    ws.create_account(address=CONTRACT_ADDR, concrete_storage=True,
+                      code=Disassembly(code))
+    laser = LaserEVM(transaction_count=1, requires_statespace=False)
+    laser.open_states = [ws]
+    execute_message_call(laser, symbol_factory.BitVecVal(CONTRACT_ADDR, 256))
+    # both branches terminate in STOP -> two open states
+    assert len(laser.open_states) == 2
+
+
+def test_selfdestruct_harvests_balance():
+    code = easm_to_code("""
+        CALLER
+        SELFDESTRUCT
+    """)
+    ws = WorldState()
+    acct = ws.create_account(address=CONTRACT_ADDR, concrete_storage=True,
+                             code=Disassembly(code))
+    # pin concrete initial balances (they default to a free symbolic array)
+    ws.balances[symbol_factory.BitVecVal(CONTRACT_ADDR, 256)] = (
+        symbol_factory.BitVecVal(1000, 256)
+    )
+    ws.balances[symbol_factory.BitVecVal(CALLER_ADDR, 256)] = (
+        symbol_factory.BitVecVal(0, 256)
+    )
+    laser = LaserEVM(transaction_count=1, requires_statespace=False)
+    laser.open_states = [ws]
+    execute_transaction(laser, CONTRACT_ADDR, CALLER_ADDR)
+    assert laser.open_states
+    final = laser.open_states[0]
+    assert final.accounts[CONTRACT_ADDR].deleted
+    caller_balance = final.balances[symbol_factory.BitVecVal(CALLER_ADDR, 256)]
+    assert caller_balance.concrete_value == 1000
+
+
+def test_precompile_identity_and_sha256():
+    import hashlib
+
+    # call identity(0x04) copying 4 bytes, then sha256(0x02)
+    easm = """
+        PUSH1 0xaa
+        PUSH1 0x00
+        MSTORE8
+        PUSH1 0xbb
+        PUSH1 0x01
+        MSTORE8
+        PUSH1 0x02
+        PUSH1 0x20
+        PUSH1 0x02
+        PUSH1 0x00
+        PUSH1 0x00
+        PUSH1 0x04
+        PUSH2 0xffff
+        CALL
+        POP
+        PUSH1 0x20
+        MLOAD
+        PUSH1 0x00
+        SSTORE
+        PUSH1 0x20
+        PUSH1 0x40
+        PUSH1 0x02
+        PUSH1 0x00
+        PUSH1 0x00
+        PUSH1 0x02
+        PUSH2 0xffff
+        CALL
+        POP
+        PUSH1 0x40
+        MLOAD
+        PUSH1 0x01
+        SSTORE
+        STOP
+    """
+    acct = run_concrete(easm)
+    # identity copied 2 bytes aa bb into mem[0x20..0x22); word read is aabb<<240
+    assert storage_value(acct, 0) >> 240 == 0xAABB
+    digest = hashlib.sha256(bytes([0xAA, 0xBB])).digest()
+    assert storage_value(acct, 1) == int.from_bytes(digest, "big")
