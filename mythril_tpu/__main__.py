@@ -1,0 +1,3 @@
+from mythril_tpu.interfaces.cli import main
+
+main()

@@ -1,0 +1,9 @@
+from mythril_tpu.analysis.module.base import (  # noqa: F401
+    DetectionModule,
+    EntryPoint,
+)
+from mythril_tpu.analysis.module.loader import ModuleLoader  # noqa: F401
+from mythril_tpu.analysis.module.util import (  # noqa: F401
+    get_detection_module_hooks,
+    reset_callback_modules,
+)

@@ -1,0 +1,75 @@
+"""DetectionModule base (reference analysis/module/base.py:120).
+
+A module declares hook opcodes (pre/post) or a POST entry point; `execute`
+runs the module's `_analyze_state` with an issue cache keyed by
+(address, bytecode_hash) so re-visited program points are skipped."""
+
+import logging
+from enum import Enum
+from typing import List, Optional, Set, Tuple
+
+log = logging.getLogger(__name__)
+
+
+class EntryPoint(Enum):
+    POST = 1        # runs over the recorded statespace after execution
+    CALLBACK = 2    # runs from opcode hooks during execution
+
+
+class DetectionModule:
+    name = "detection module"
+    swc_id = ""
+    description = ""
+    entry_point: EntryPoint = EntryPoint.CALLBACK
+    pre_hooks: List[str] = []
+    post_hooks: List[str] = []
+
+    def __init__(self):
+        self.issues: List = []
+        self.cache: Set[Tuple[int, bytes]] = set()
+        # hook context, set per-invocation by execute(): which opcode fired
+        # the hook and whether it was a pre- or post-hook (post-hooks see the
+        # state AFTER execution, pc already advanced)
+        self.current_opcode: Optional[str] = None
+        self.is_prehook: bool = True
+
+    def reset_module(self):
+        self.issues = []
+
+    def update_cache(self, issues=None):
+        issues = issues if issues is not None else self.issues
+        for issue in issues:
+            self.cache.add((issue.address, issue.bytecode_hash))
+
+    def _cache_key(self, global_state) -> Tuple[int, str]:
+        instruction = global_state.get_current_instruction()
+        address = instruction.address if instruction is not None else -1
+        return (
+            address,
+            "0x" + global_state.environment.code.bytecode_hash.hex(),
+        )
+
+    def execute(self, target, opcode: Optional[str] = None,
+                prehook: bool = True) -> Optional[List]:
+        """target: GlobalState for CALLBACK modules, statespace for POST."""
+        if self.entry_point == EntryPoint.CALLBACK:
+            self.current_opcode = opcode
+            self.is_prehook = prehook
+            if prehook and self._cache_key(target) in self.cache:
+                return None
+            result = self._analyze_state(target)
+        else:
+            result = self._analyze_statespace(target)
+        if result:
+            self.issues.extend(result)
+            self.update_cache(result)
+        return result
+
+    def _analyze_state(self, global_state) -> List:
+        return []
+
+    def _analyze_statespace(self, statespace) -> List:
+        return []
+
+    def __repr__(self):
+        return f"<DetectionModule {self.name} swc={self.swc_id}>"

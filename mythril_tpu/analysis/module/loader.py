@@ -1,0 +1,100 @@
+"""ModuleLoader singleton registering the built-in detection modules
+(reference analysis/module/loader.py:91-112)."""
+
+import logging
+from typing import List, Optional
+
+from mythril_tpu.analysis.module.base import DetectionModule, EntryPoint
+
+log = logging.getLogger(__name__)
+
+
+class ModuleLoader:
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+            cls._instance._modules = []
+            cls._instance._register_mythril_modules()
+        return cls._instance
+
+    def register_module(self, module: DetectionModule):
+        if not isinstance(module, DetectionModule):
+            raise ValueError("registered modules must extend DetectionModule")
+        self._modules.append(module)
+
+    def get_detection_modules(
+        self,
+        entry_point: Optional[EntryPoint] = None,
+        white_list: Optional[List[str]] = None,
+    ) -> List[DetectionModule]:
+        result = self._modules[:]
+        if white_list:
+            available = {module.name for module in result}
+            unknown = set(white_list) - available
+            if unknown:
+                raise ValueError(
+                    f"unknown detection module(s): {', '.join(sorted(unknown))}"
+                )
+            result = [m for m in result if m.name in white_list]
+        if entry_point:
+            result = [m for m in result if m.entry_point == entry_point]
+        return result
+
+    def _register_mythril_modules(self):
+        from mythril_tpu.analysis.module.modules.arbitrary_jump import ArbitraryJump
+        from mythril_tpu.analysis.module.modules.arbitrary_write import (
+            ArbitraryStorage,
+        )
+        from mythril_tpu.analysis.module.modules.delegatecall import (
+            ArbitraryDelegateCall,
+        )
+        from mythril_tpu.analysis.module.modules.dependence_on_origin import TxOrigin
+        from mythril_tpu.analysis.module.modules.dependence_on_predictable_vars import (
+            PredictableVariables,
+        )
+        from mythril_tpu.analysis.module.modules.ether_thief import EtherThief
+        from mythril_tpu.analysis.module.modules.exceptions import Exceptions
+        from mythril_tpu.analysis.module.modules.external_calls import ExternalCalls
+        from mythril_tpu.analysis.module.modules.integer import IntegerArithmetics
+        from mythril_tpu.analysis.module.modules.multiple_sends import MultipleSends
+        from mythril_tpu.analysis.module.modules.requirements_violation import (
+            RequirementsViolation,
+        )
+        from mythril_tpu.analysis.module.modules.state_change_external_calls import (
+            StateChangeAfterCall,
+        )
+        from mythril_tpu.analysis.module.modules.suicide import AccidentallyKillable
+        from mythril_tpu.analysis.module.modules.transaction_order_dependence import (
+            TxOrderDependence,
+        )
+        from mythril_tpu.analysis.module.modules.unchecked_retval import (
+            UncheckedRetval,
+        )
+        from mythril_tpu.analysis.module.modules.unexpected_ether import (
+            UnexpectedEther,
+        )
+        from mythril_tpu.analysis.module.modules.user_assertions import (
+            UserAssertions,
+        )
+
+        self._modules = [
+            ArbitraryJump(),
+            ArbitraryStorage(),
+            ArbitraryDelegateCall(),
+            TxOrigin(),
+            PredictableVariables(),
+            EtherThief(),
+            Exceptions(),
+            ExternalCalls(),
+            IntegerArithmetics(),
+            MultipleSends(),
+            RequirementsViolation(),
+            StateChangeAfterCall(),
+            AccidentallyKillable(),
+            TxOrderDependence(),
+            UncheckedRetval(),
+            UnexpectedEther(),
+            UserAssertions(),
+        ]

@@ -1,0 +1,1 @@
+"""The built-in detection modules (reference analysis/module/modules/)."""

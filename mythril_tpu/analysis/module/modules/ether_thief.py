@@ -1,0 +1,79 @@
+"""EtherThief — SWC-105 unprotected ether withdrawal
+(reference analysis/module/modules/ether_thief.py:100)."""
+
+import logging
+
+from mythril_tpu.analysis.module.base import DetectionModule, EntryPoint
+from mythril_tpu.analysis.potential_issues import (
+    PotentialIssue,
+    get_potential_issues_annotation,
+)
+from mythril_tpu.analysis.swc_data import UNPROTECTED_ETHER_WITHDRAWAL
+from mythril_tpu.laser.transaction.symbolic import ACTORS
+from mythril_tpu.smt import UGT
+from mythril_tpu.support.model import get_model
+from mythril_tpu.smt.solver.frontend import UnsatError
+
+log = logging.getLogger(__name__)
+
+DESCRIPTION_HEAD = "Any sender can withdraw ETH from the contract account."
+DESCRIPTION_TAIL = (
+    "Arbitrary senders other than the contract creator can profitably "
+    "extract ETH from the contract account. Verify the business logic "
+    "carefully and make sure that appropriate security controls are in "
+    "place to prevent unexpected loss of funds."
+)
+
+
+class EtherThief(DetectionModule):
+    name = "ether_thief"
+    swc_id = UNPROTECTED_ETHER_WITHDRAWAL
+    description = DESCRIPTION_HEAD
+    entry_point = EntryPoint.CALLBACK
+    post_hooks = ["CALL", "STATICCALL"]
+
+    def _analyze_state(self, state):
+        instruction = state.get_current_instruction()
+
+        constraints = []
+        world_state = state.world_state
+        for tx in world_state.transaction_sequence:
+            if not isinstance(tx.caller, int) and tx.caller.symbolic:
+                constraints.append(tx.caller == ACTORS.attacker)
+            # exploit must not rely on the attacker seeding the contract
+            if tx.call_value is not None and tx.call_value.symbolic:
+                constraints.append(tx.call_value == 0)
+        constraints.append(
+            UGT(
+                world_state.balances[ACTORS.attacker],
+                world_state.starting_balances[ACTORS.attacker],
+            )
+        )
+
+        try:
+            get_model(
+                world_state.constraints.get_all_constraints() + constraints
+            )
+        except UnsatError:
+            return []
+        except Exception:
+            return []
+
+        potential_issue = PotentialIssue(
+            contract=state.environment.active_account.contract_name,
+            function_name=state.environment.active_function_name,
+            # post-hook state: pc advanced past the 1-byte CALL opcode
+            address=instruction.address - 1,
+            swc_id=UNPROTECTED_ETHER_WITHDRAWAL,
+            title="Unprotected Ether Withdrawal",
+            severity="High",
+            bytecode=state.environment.code.bytecode,
+            description_head=DESCRIPTION_HEAD,
+            description_tail=DESCRIPTION_TAIL,
+            constraints=constraints,
+            detector=self,
+        )
+        get_potential_issues_annotation(state).potential_issues.append(
+            potential_issue
+        )
+        return []

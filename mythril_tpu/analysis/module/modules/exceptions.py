@@ -1,0 +1,54 @@
+"""Exceptions — SWC-110 reachable assert violation
+(reference analysis/module/modules/exceptions.py:152)."""
+
+import logging
+
+from mythril_tpu.analysis.module.base import DetectionModule, EntryPoint
+from mythril_tpu.analysis.report import Issue
+from mythril_tpu.analysis.solver import get_transaction_sequence
+from mythril_tpu.analysis.swc_data import ASSERT_VIOLATION
+from mythril_tpu.smt.solver.frontend import SolverTimeOutException, UnsatError
+
+log = logging.getLogger(__name__)
+
+DESCRIPTION_HEAD = "An assertion violation was triggered."
+DESCRIPTION_TAIL = (
+    "It is possible to trigger an assertion violation. Note that Solidity "
+    "assert() statements should only be used to check invariants. Review "
+    "the transaction trace generated for this issue and either make sure "
+    "your program logic is correct, or use require() instead of assert() "
+    "if your goal is to constrain user inputs or enforce preconditions."
+)
+
+
+class Exceptions(DetectionModule):
+    name = "exceptions"
+    swc_id = ASSERT_VIOLATION
+    description = DESCRIPTION_HEAD
+    entry_point = EntryPoint.CALLBACK
+    pre_hooks = ["INVALID"]
+
+    def _analyze_state(self, state):
+        instruction = state.get_current_instruction()
+        try:
+            transaction_sequence = get_transaction_sequence(
+                state, state.world_state.constraints
+            )
+        except (UnsatError, SolverTimeOutException):
+            return []
+        except Exception:
+            return []
+        return [
+            Issue(
+                contract=state.environment.active_account.contract_name,
+                function_name=state.environment.active_function_name,
+                address=instruction.address,
+                swc_id=ASSERT_VIOLATION,
+                title="Exception State",
+                severity="Medium",
+                bytecode=state.environment.code.bytecode,
+                description_head=DESCRIPTION_HEAD,
+                description_tail=DESCRIPTION_TAIL,
+                transaction_sequence=transaction_sequence,
+            )
+        ]

@@ -1,0 +1,150 @@
+"""IntegerArithmetics — SWC-101 overflow/underflow reaching a sink
+(reference analysis/module/modules/integer.py:350).
+
+Mechanism: pre-hooks on ADD/SUB/MUL/EXP capture the operands; the matching
+post-hook annotates the pushed result with the overflow predicate. Sink
+hooks (SSTORE/JUMPI/CALL) promote annotated values whose predicate is
+satisfiable into PotentialIssues."""
+
+import logging
+from typing import List, Optional, Tuple
+
+from mythril_tpu.analysis.module.base import DetectionModule, EntryPoint
+from mythril_tpu.analysis.potential_issues import (
+    PotentialIssue,
+    get_potential_issues_annotation,
+)
+from mythril_tpu.analysis.swc_data import INTEGER_OVERFLOW_AND_UNDERFLOW
+from mythril_tpu.smt import (
+    BVAddNoOverflow,
+    BVMulNoOverflow,
+    BVSubNoUnderflow,
+    Bool,
+    Not,
+)
+from mythril_tpu.support.args import args
+
+log = logging.getLogger(__name__)
+
+
+class OverUnderflowAnnotation:
+    __slots__ = ("overflowing_state_address", "operator", "constraint")
+
+    def __init__(self, address: int, operator: str, constraint: Bool):
+        self.overflowing_state_address = address
+        self.operator = operator
+        self.constraint = constraint
+
+
+class IntegerArithmetics(DetectionModule):
+    name = "integer_overflow_and_underflow"
+    swc_id = INTEGER_OVERFLOW_AND_UNDERFLOW
+    description = "Integer overflow or underflow reaching a sink."
+    entry_point = EntryPoint.CALLBACK
+    pre_hooks = ["ADD", "SUB", "MUL", "SSTORE", "JUMPI", "CALL"]
+    post_hooks = ["ADD", "SUB", "MUL"]
+
+    def __init__(self):
+        super().__init__()
+        self._pending: Optional[Tuple[str, int, Bool]] = None
+
+    def _analyze_state(self, state) -> List:
+        if not args.use_integer_module:
+            return []
+        opcode = self.current_opcode
+        if opcode in ("ADD", "SUB", "MUL"):
+            if self.is_prehook:
+                self._capture_operands(state, opcode)
+            else:
+                self._annotate_result(state)
+            return []
+        return self._check_sink(state, opcode)
+
+    def _capture_operands(self, state, opcode: str) -> None:
+        self._pending = None
+        stack = state.mstate.stack
+        a, b = stack[-1], stack[-2]
+        if not a.symbolic and not b.symbolic:
+            return
+        address = state.get_current_instruction().address
+        if opcode == "ADD":
+            constraint = Not(BVAddNoOverflow(a, b, False))
+            operator = "addition"
+        elif opcode == "SUB":
+            constraint = Not(BVSubNoUnderflow(a, b, False))
+            operator = "subtraction"
+        else:
+            constraint = Not(BVMulNoOverflow(a, b, False))
+            operator = "multiplication"
+        self._pending = (operator, address, constraint)
+
+    def _annotate_result(self, state) -> None:
+        if self._pending is None:
+            return
+        operator, address, constraint = self._pending
+        self._pending = None
+        if state.mstate.stack:
+            state.mstate.stack[-1].annotate(
+                OverUnderflowAnnotation(address, operator, constraint)
+            )
+
+    def _sink_values(self, state, opcode: str) -> List:
+        stack = state.mstate.stack
+        if opcode == "SSTORE":
+            return [stack[-1], stack[-2]]
+        if opcode == "JUMPI":
+            return [stack[-2]]
+        if opcode == "CALL":
+            return [stack[-3]]
+        return []
+
+    def _check_sink(self, state, opcode: str) -> List:
+        issues = []
+        annotation_bucket = get_potential_issues_annotation(state)
+        for value in self._sink_values(state, opcode):
+            for marker in value.get_annotations(OverUnderflowAnnotation):
+                title = (
+                    "Integer Arithmetic Bugs"
+                )
+                potential_issue = PotentialIssue(
+                    contract=state.environment.active_account.contract_name,
+                    function_name=state.environment.active_function_name,
+                    address=marker.overflowing_state_address,
+                    swc_id=INTEGER_OVERFLOW_AND_UNDERFLOW,
+                    title=title,
+                    severity="High",
+                    bytecode=state.environment.code.bytecode,
+                    description_head=(
+                        "The arithmetic operator can "
+                        + ("underflow." if marker.operator == "subtraction"
+                           else "overflow.")
+                    ),
+                    description_tail=(
+                        f"It is possible to cause an integer overflow or "
+                        f"underflow in the arithmetic operation "
+                        f"({marker.operator}). Prevent this by constraining "
+                        f"inputs using the require() statement or use the "
+                        f"OpenZeppelin SafeMath library for integer "
+                        f"arithmetic operations."
+                    ),
+                    constraints=[marker.constraint],
+                    detector=self,
+                )
+                if not self._already_recorded(annotation_bucket, potential_issue):
+                    annotation_bucket.potential_issues.append(potential_issue)
+        return issues
+
+    @staticmethod
+    def _already_recorded(annotation_bucket, candidate) -> bool:
+        # dedup must include the predicate: the same ADD address is reached
+        # in every transaction, each with a different overflow constraint
+        candidate_key = tuple(hash(c) for c in candidate.constraints)
+        for issue in annotation_bucket.potential_issues:
+            if (
+                issue.address == candidate.address
+                and issue.swc_id == candidate.swc_id
+                and issue.detector is candidate.detector
+                and tuple(hash(c) for c in issue.constraints) == candidate_key
+            ):
+                return True
+        return False

@@ -1,0 +1,83 @@
+"""AccidentallyKillable — SWC-106 unprotected SELFDESTRUCT
+(reference analysis/module/modules/suicide.py:125)."""
+
+import logging
+
+from mythril_tpu.analysis.module.base import DetectionModule, EntryPoint
+from mythril_tpu.analysis.potential_issues import (
+    PotentialIssue,
+    get_potential_issues_annotation,
+)
+from mythril_tpu.analysis.swc_data import UNPROTECTED_SELFDESTRUCT
+from mythril_tpu.laser.transaction.symbolic import ACTORS
+from mythril_tpu.smt.solver.frontend import UnsatError
+from mythril_tpu.support.model import get_model
+
+log = logging.getLogger(__name__)
+
+DESCRIPTION_HEAD = "Any sender can cause the contract to self-destruct."
+DESCRIPTION_TAIL = (
+    "Any sender can trigger execution of the SELFDESTRUCT instruction to "
+    "destroy this contract account and withdraw its balance to an arbitrary "
+    "address. Review the transaction trace generated for this issue and "
+    "make sure that appropriate security controls are in place to prevent "
+    "unrestricted access."
+)
+
+
+class AccidentallyKillable(DetectionModule):
+    name = "accidentally_killable"
+    swc_id = UNPROTECTED_SELFDESTRUCT
+    description = DESCRIPTION_HEAD
+    entry_point = EntryPoint.CALLBACK
+    pre_hooks = ["SELFDESTRUCT"]
+
+    def _analyze_state(self, state):
+        instruction = state.get_current_instruction()
+        to = state.mstate.stack[-1]
+
+        attacker_constraints = []
+        for tx in state.world_state.transaction_sequence:
+            if not isinstance(tx.caller, int) and tx.caller.symbolic:
+                attacker_constraints.append(tx.caller == ACTORS.attacker)
+
+        try:
+            # strongest variant: attacker also receives the funds
+            constraints = attacker_constraints + [to == ACTORS.attacker]
+            get_model(
+                state.world_state.constraints.get_all_constraints() + constraints
+            )
+            description_tail = (
+                DESCRIPTION_TAIL
+                + " The attacker controls the beneficiary address."
+            )
+        except UnsatError:
+            try:
+                constraints = attacker_constraints
+                get_model(
+                    state.world_state.constraints.get_all_constraints()
+                    + constraints
+                )
+                description_tail = DESCRIPTION_TAIL
+            except UnsatError:
+                return []
+        except Exception:
+            return []
+
+        potential_issue = PotentialIssue(
+            contract=state.environment.active_account.contract_name,
+            function_name=state.environment.active_function_name,
+            address=instruction.address,
+            swc_id=UNPROTECTED_SELFDESTRUCT,
+            title="Unprotected Selfdestruct",
+            severity="High",
+            bytecode=state.environment.code.bytecode,
+            description_head=DESCRIPTION_HEAD,
+            description_tail=description_tail,
+            constraints=constraints,
+            detector=self,
+        )
+        get_potential_issues_annotation(state).potential_issues.append(
+            potential_issue
+        )
+        return []

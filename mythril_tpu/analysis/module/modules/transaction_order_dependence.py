@@ -1,0 +1,122 @@
+"""TransactionOrderDependence — SWC-114 value transfer racing on storage
+(reference analysis/module/modules/transaction_order_dependence.py:140,
+POST entry).
+
+Heuristic (mirrors the reference): find CALL ops whose transfer value
+depends on a storage read, and SSTORE writes (in other transactions) that
+may alias the slot feeding that value — front-running the write changes
+what the call pays out."""
+
+import logging
+
+from mythril_tpu.analysis.module.base import DetectionModule, EntryPoint
+from mythril_tpu.analysis.report import Issue
+from mythril_tpu.analysis.solver import get_transaction_sequence
+from mythril_tpu.analysis.swc_data import TX_ORDER_DEPENDENCE
+from mythril_tpu.smt import terms as _terms
+from mythril_tpu.smt.solver.frontend import SolverTimeOutException, UnsatError
+
+log = logging.getLogger(__name__)
+
+
+def _storage_reads(term):
+    """Base-array storage selects inside a term."""
+    reads = []
+    for node in _terms.walk_terms([term]):
+        if node.op == "select":
+            base = node.children[0]
+            while base.op == "store":
+                base = base.children[0]
+            if base.op == "array" and str(base.params[0]).startswith("Storage"):
+                reads.append((base.params[0], node.children[1]))
+    return reads
+
+
+class TxOrderDependence(DetectionModule):
+    name = "tx_order_dependence"
+    swc_id = TX_ORDER_DEPENDENCE
+    description = "The call value depends on storage writable by other transactions."
+    entry_point = EntryPoint.POST
+
+    def _analyze_statespace(self, statespace) -> list:
+        issues = []
+        # gather storage-dependent call values and sstore events
+        calls = []   # (state, instruction, reads)
+        writes = []  # (tx_id, slot_term)
+        for node in statespace.nodes.values():
+            for state in node.states:
+                instruction = state.get_current_instruction()
+                if instruction is None:
+                    continue
+                stack = (
+                    state.mstate_stack
+                    if hasattr(state, "mstate_stack")
+                    else state.mstate.stack
+                )
+                if instruction.opcode in ("CALL", "CALLCODE") and len(stack) >= 3:
+                    value = stack[-3]
+                    if value.symbolic:
+                        reads = _storage_reads(value.raw)
+                        if reads:
+                            calls.append((state, instruction, reads))
+                elif instruction.opcode == "SSTORE" and len(stack) >= 2:
+                    tx = state.transaction
+                    writes.append(
+                        (tx.id if tx else None, stack[-1].raw)
+                    )
+        seen = set()
+        for state, instruction, reads in calls:
+            tx = state.transaction
+            tx_id = tx.id if tx else None
+            racing = False
+            for write_tx, write_slot in writes:
+                if write_tx == tx_id:
+                    continue  # same transaction cannot be front-run
+                for _arr, read_slot in reads:
+                    alias = _terms.eq(write_slot, read_slot)
+                    if not (alias.is_const and alias.value is False):
+                        racing = True
+                        break
+                if racing:
+                    break
+            if not racing:
+                continue
+            key = (
+                instruction.address,
+                "0x" + state.environment.code.bytecode_hash.hex(),
+            )
+            if key in seen or key in self.cache:
+                continue
+            try:
+                transaction_sequence = get_transaction_sequence(
+                    state, state.constraints
+                )
+            except (UnsatError, SolverTimeOutException, AttributeError):
+                continue
+            except Exception:
+                continue
+            seen.add(key)
+            issues.append(
+                Issue(
+                    contract=state.environment.active_account.contract_name,
+                    function_name=state.environment.active_function_name,
+                    address=instruction.address,
+                    swc_id=TX_ORDER_DEPENDENCE,
+                    title="Transaction Order Dependence",
+                    severity="Medium",
+                    bytecode=state.environment.code.bytecode,
+                    description_head=(
+                        "The value of the call is dependent on balance or "
+                        "storage write"
+                    ),
+                    description_tail=(
+                        "This can lead to race conditions. An attacker may be "
+                        "able to run a transaction after our transaction which "
+                        "can change the value of the call, e.g. by "
+                        "front-running a storage write that determines the "
+                        "amount paid out."
+                    ),
+                    transaction_sequence=transaction_sequence,
+                )
+            )
+        return issues

@@ -1,0 +1,76 @@
+"""UserAssertions — SWC-110 solidity 0.8 Panic / user-defined assert messages
+(reference analysis/module/modules/user_assertions.py:131)."""
+
+import logging
+
+from mythril_tpu.analysis.module.base import DetectionModule, EntryPoint
+from mythril_tpu.analysis.report import Issue
+from mythril_tpu.analysis.solver import get_transaction_sequence
+from mythril_tpu.analysis.swc_data import ASSERT_VIOLATION
+from mythril_tpu.laser.instructions import concrete_or_none
+from mythril_tpu.smt.solver.frontend import SolverTimeOutException, UnsatError
+
+log = logging.getLogger(__name__)
+
+# Panic(uint256) selector and assertion-failure code 0x01
+PANIC_SELECTOR = 0x4E487B71
+# Error(string) selector for revert reasons
+ERROR_SELECTOR = 0x08C379A0
+
+
+class UserAssertions(DetectionModule):
+    name = "user_assertions"
+    swc_id = ASSERT_VIOLATION
+    description = "A user-provided assertion failed."
+    entry_point = EntryPoint.CALLBACK
+    pre_hooks = ["REVERT"]
+
+    def _analyze_state(self, state):
+        offset, length = state.mstate.stack[-1], state.mstate.stack[-2]
+        offset_c = concrete_or_none(offset)
+        length_c = concrete_or_none(length)
+        if offset_c is None or length_c is None or length_c < 4:
+            return []
+        word = state.mstate.memory.get_word_at(offset_c)
+        selector_bv = concrete_or_none(word)
+        if selector_bv is None:
+            return []
+        selector = selector_bv >> 224
+        if selector == PANIC_SELECTOR:
+            if length_c < 36:
+                return []
+            code_bv = concrete_or_none(
+                state.mstate.memory.get_word_at(offset_c + 4)
+            )
+            if code_bv != 1:  # Panic(0x01) == assert failure
+                return []
+            message = "An assertion violation was triggered (Panic 0x01)."
+        elif selector == ERROR_SELECTOR:
+            message = "A user-provided string assertion failed."
+        else:
+            return []
+        try:
+            transaction_sequence = get_transaction_sequence(
+                state, state.world_state.constraints
+            )
+        except (UnsatError, SolverTimeOutException):
+            return []
+        except Exception:
+            return []
+        return [
+            Issue(
+                contract=state.environment.active_account.contract_name,
+                function_name=state.environment.active_function_name,
+                address=state.get_current_instruction().address,
+                swc_id=ASSERT_VIOLATION,
+                title="Exception State",
+                severity="Medium",
+                bytecode=state.environment.code.bytecode,
+                description_head=message,
+                description_tail=(
+                    "Review the transaction trace to see under which "
+                    "conditions the assertion can be violated."
+                ),
+                transaction_sequence=transaction_sequence,
+            )
+        ]

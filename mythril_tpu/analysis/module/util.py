@@ -1,0 +1,45 @@
+"""Hook-table construction for detection modules
+(reference analysis/module/util.py:13-43)."""
+
+from collections import defaultdict
+from typing import Callable, Dict, List, Optional
+
+from mythril_tpu.analysis.module.base import DetectionModule, EntryPoint
+from mythril_tpu.analysis.module.loader import ModuleLoader
+from mythril_tpu.support.opcodes import BY_NAME
+
+
+def get_detection_module_hooks(
+    modules: List[DetectionModule], hook_type: str = "pre"
+) -> Dict[str, List[Callable]]:
+    """Build opcode -> [module.execute] tables. Supports the reference's
+    PREFIX* wildcard hook names (e.g. 'PUSH' matching PUSH1..32)."""
+    hook_dict = defaultdict(list)
+    prehook = hook_type == "pre"
+
+    def bind(module, op_name):
+        def hook(state, _m=module, _n=op_name, _p=prehook):
+            return _m.execute(state, opcode=_n, prehook=_p)
+
+        return hook
+
+    for module in modules:
+        if module.entry_point != EntryPoint.CALLBACK:
+            continue
+        hooks = module.pre_hooks if prehook else module.post_hooks
+        for op_name in hooks:
+            if op_name in BY_NAME:
+                hook_dict[op_name].append(bind(module, op_name))
+            else:
+                # wildcard prefix: register on every matching opcode
+                for name in (n for n in BY_NAME if n.startswith(op_name)):
+                    hook_dict[name].append(bind(module, name))
+    return dict(hook_dict)
+
+
+def reset_callback_modules(module_names: Optional[List[str]] = None):
+    for module in ModuleLoader().get_detection_modules(
+        white_list=module_names
+    ):
+        if module.entry_point == EntryPoint.CALLBACK:
+            module.reset_module()

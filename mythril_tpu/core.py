@@ -1,0 +1,178 @@
+"""Orchestration: per-contract analysis driver
+(reference mythril/mythril/mythril_analyzer.py:201 +
+mythril_disassembler.py:411, merged into one module — the solc/RPC loading
+paths live in solidity/ and ethereum/ and are dispatched from here)."""
+
+import logging
+import traceback
+from typing import List, Optional
+
+from mythril_tpu.analysis.report import Issue, Report
+from mythril_tpu.analysis.security import fire_lasers, retrieve_callback_issues
+from mythril_tpu.analysis.symbolic import SymExecWrapper
+from mythril_tpu.ethereum.evmcontract import EVMContract
+from mythril_tpu.smt.solver.statistics import SolverStatistics
+from mythril_tpu.support.args import args
+from mythril_tpu.laser.transaction.models import tx_id_manager
+
+log = logging.getLogger(__name__)
+
+ANALYSIS_ADDRESS = 0x901D12EBE1B195E5AA8748E62BD7734AE19B51F  # well-known probe address
+
+
+class MythrilDisassembler:
+    """Loads bytecode into EVMContract objects."""
+
+    def __init__(self, eth=None, enable_online_lookup: bool = False):
+        self.eth = eth
+        self.contracts: List[EVMContract] = []
+        self.enable_online_lookup = enable_online_lookup
+
+    def load_from_bytecode(self, code: str, bin_runtime: bool = False,
+                           address: Optional[str] = None) -> EVMContract:
+        if bin_runtime:
+            contract = EVMContract(code=code, name="MAIN")
+        else:
+            contract = EVMContract(creation_code=code, name="MAIN")
+        self.contracts.append(contract)
+        return contract
+
+    def load_from_address(self, address: str) -> EVMContract:
+        if self.eth is None:
+            raise ValueError("no RPC client configured (use --rpc)")
+        code = self.eth.eth_getCode(address)
+        contract = EVMContract(code=code, name=address)
+        self.contracts.append(contract)
+        return contract
+
+    def load_from_solidity(self, solidity_files: List[str]):
+        from mythril_tpu.solidity.soliditycontract import get_contracts_from_file
+
+        contracts = []
+        for file in solidity_files:
+            contracts.extend(get_contracts_from_file(file))
+        self.contracts.extend(contracts)
+        return contracts
+
+
+class MythrilAnalyzer:
+    """Runs symbolic execution + modules per contract, renders the Report."""
+
+    def __init__(
+        self,
+        disassembler: MythrilDisassembler,
+        cmd_args=None,
+        strategy: str = "bfs",
+        address: Optional[int] = None,
+    ):
+        self.contracts = disassembler.contracts
+        self.strategy = strategy
+        self.address = address if address is not None else ANALYSIS_ADDRESS
+        # copy CLI args into the global singleton (reference :65-76)
+        if cmd_args is not None:
+            for field in (
+                "solver_timeout", "execution_timeout", "create_timeout",
+                "max_depth", "loop_bound", "transaction_count",
+                "pruning_factor", "call_depth_limit", "solver_log",
+                "unconstrained_storage", "parallel_solving", "disable_iprof",
+                "disable_mutation_pruner", "disable_dependency_pruning",
+                "enable_state_merging", "enable_summaries", "solver_backend",
+                "transaction_sequences",
+            ):
+                if hasattr(cmd_args, field) and getattr(cmd_args, field) is not None:
+                    setattr(args, field, getattr(cmd_args, field))
+        # auto pruning factor (reference :78-82)
+        if args.pruning_factor is None:
+            args.pruning_factor = 1.0 if args.execution_timeout > 300 else 0.0
+
+    def fire_lasers(self, modules: Optional[List[str]] = None,
+                    transaction_count: Optional[int] = None) -> Report:
+        stats = SolverStatistics()
+        stats.enabled = True
+        all_issues: List[Issue] = []
+        exceptions: List[str] = []
+        tx_count = transaction_count or args.transaction_count
+        for contract in self.contracts:
+            tx_id_manager.restart_counter()
+            from mythril_tpu.laser.function_managers import (
+                keccak_function_manager,
+            )
+
+            keccak_function_manager.reset()
+            try:
+                sym = SymExecWrapper(
+                    contract,
+                    self.address,
+                    self.strategy,
+                    max_depth=args.max_depth,
+                    execution_timeout=args.execution_timeout,
+                    loop_bound=args.loop_bound,
+                    create_timeout=args.create_timeout,
+                    transaction_count=tx_count,
+                    modules=modules,
+                    compulsory_statespace=False,
+                )
+                issues = fire_lasers(sym, white_list=modules)
+            except KeyboardInterrupt:
+                log.critical("keyboard interrupt: retrieving partial results")
+                issues = retrieve_callback_issues(modules)
+            except Exception:
+                log.exception("exception during analysis of %s", contract.name)
+                exceptions.append(traceback.format_exc())
+                issues = retrieve_callback_issues(modules)
+            for issue in issues:
+                issue.add_code_info(contract)
+                issue.resolve_function_name(_signature_db())
+            log.info(str(stats))
+            all_issues.extend(issues)
+
+        report = Report(
+            contracts=self.contracts,
+            exceptions=exceptions,
+        )
+        for issue in all_issues:
+            report.append_issue(issue)
+        return report
+
+    def dump_statespace(self, contract=None) -> str:
+        """JSON statespace dump (reference mythril_analyzer.py:84)."""
+        from mythril_tpu.analysis.traceexplore import get_serializable_statespace
+
+        contract = contract or self.contracts[0]
+        sym = SymExecWrapper(
+            contract,
+            self.address,
+            self.strategy,
+            max_depth=args.max_depth,
+            execution_timeout=args.execution_timeout,
+            transaction_count=args.transaction_count,
+            compulsory_statespace=True,
+        )
+        import json
+
+        return json.dumps(get_serializable_statespace(sym))
+
+    def graph_html(self, contract=None, enable_physics: bool = False) -> str:
+        """Interactive vis.js CFG html (reference mythril_analyzer.py:105)."""
+        from mythril_tpu.analysis.callgraph import generate_graph
+
+        contract = contract or self.contracts[0]
+        sym = SymExecWrapper(
+            contract,
+            self.address,
+            self.strategy,
+            max_depth=args.max_depth,
+            execution_timeout=args.execution_timeout,
+            transaction_count=args.transaction_count,
+            compulsory_statespace=True,
+        )
+        return generate_graph(sym, physics=enable_physics)
+
+
+def _signature_db():
+    try:
+        from mythril_tpu.support.signatures import SignatureDB
+
+        return SignatureDB()
+    except Exception:
+        return None

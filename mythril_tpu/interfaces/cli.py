@@ -1,0 +1,290 @@
+"""`myth`-compatible command line (reference mythril/interfaces/cli.py:976).
+
+Subcommands: analyze/a, disassemble/d, list-detectors, function-to-hash,
+hash-to-address, safe-functions, concolic/c, version, help. Exit code 1 iff
+issues were found (reference cli.py:875-878)."""
+
+import argparse
+import json
+import logging
+import os
+import sys
+
+from mythril_tpu.version import __version__
+
+log = logging.getLogger(__name__)
+
+COMMAND_ALIASES = {"a": "analyze", "d": "disassemble", "c": "concolic"}
+
+
+def main() -> None:
+    parser = build_parser()
+    argv = sys.argv[1:]
+    if argv and argv[0] in COMMAND_ALIASES:
+        argv[0] = COMMAND_ALIASES[argv[0]]
+    parsed = parser.parse_args(argv)
+    configure_logging(getattr(parsed, "verbose", 2))
+    try:
+        exit_code = execute_command(parsed)
+    except CliError as error:
+        print(f"error: {error}", file=sys.stderr)
+        sys.exit(2)
+    sys.exit(exit_code)
+
+
+class CliError(Exception):
+    pass
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="myth-tpu",
+        description=(
+            "mythril_tpu: TPU-native security analyzer for EVM bytecode"
+        ),
+    )
+    parser.add_argument("--version", action="version",
+                        version=f"mythril_tpu {__version__}")
+    subparsers = parser.add_subparsers(dest="command")
+
+    analyze = subparsers.add_parser("analyze", help="analyze a contract")
+    add_input_args(analyze)
+    add_analysis_args(analyze)
+    add_output_args(analyze)
+
+    disassemble = subparsers.add_parser("disassemble", help="print EASM")
+    add_input_args(disassemble)
+
+    subparsers.add_parser("list-detectors", help="list detection modules")
+
+    f2h = subparsers.add_parser("function-to-hash",
+                                help="4-byte selector of a signature")
+    f2h.add_argument("func_name", help="e.g. 'transfer(address,uint256)'")
+
+    h2a = subparsers.add_parser("hash-to-address",
+                                help="resolve a selector via the signature DB")
+    h2a.add_argument("hash", help="e.g. 0xa9059cbb")
+
+    safe = subparsers.add_parser(
+        "safe-functions", help="functions proven issue-free"
+    )
+    add_input_args(safe)
+    add_analysis_args(safe)
+
+    concolic = subparsers.add_parser("concolic", help="concolic branch flipping")
+    concolic.add_argument("input", help="concrete input json")
+    concolic.add_argument("--branches", required=True,
+                          help="comma-separated branch addresses to flip")
+    concolic.add_argument("--solver-timeout", type=int, default=100000)
+
+    subparsers.add_parser("version", help="print version")
+    return parser
+
+
+def add_input_args(parser) -> None:
+    parser.add_argument("solidity_files", nargs="*",
+                        help="solidity files (requires solc)")
+    parser.add_argument("-c", "--code", help="hex bytecode string")
+    parser.add_argument("-f", "--codefile",
+                        help="file containing hex bytecode")
+    parser.add_argument("-a", "--address", help="on-chain contract address")
+    parser.add_argument("--bin-runtime", action="store_true",
+                        help="treat -c/-f input as runtime (deployed) code")
+    parser.add_argument("--rpc", help="custom RPC endpoint host:port")
+    parser.add_argument("--rpctls", action="store_true", help="RPC over TLS")
+    parser.add_argument("-v", "--verbose", type=int, default=2,
+                        help="log level 0-5")
+
+
+def add_analysis_args(parser) -> None:
+    parser.add_argument("-m", "--modules",
+                        help="comma-separated module names to run")
+    parser.add_argument("-t", "--transaction-count", type=int, default=2)
+    parser.add_argument("--max-depth", type=int, default=128)
+    parser.add_argument("--strategy", default="bfs",
+                        choices=["dfs", "bfs", "naive-random",
+                                 "weighted-random"])
+    parser.add_argument("--execution-timeout", type=int, default=86400)
+    parser.add_argument("--create-timeout", type=int, default=10)
+    parser.add_argument("--solver-timeout", type=int, default=25000)
+    parser.add_argument("--loop-bound", type=int, default=3)
+    parser.add_argument("--call-depth-limit", type=int, default=3)
+    parser.add_argument("--pruning-factor", type=float, default=None)
+    parser.add_argument("--unconstrained-storage", action="store_true")
+    parser.add_argument("--parallel-solving", action="store_true")
+    parser.add_argument("--solver-log", help="directory for SMT2 query dumps")
+    parser.add_argument("--solver-backend", default="cpu",
+                        choices=["cpu", "tpu"],
+                        help="satisfiability backend (tpu = batched device solver)")
+    parser.add_argument("--disable-mutation-pruner", action="store_true")
+    parser.add_argument("--disable-dependency-pruning", action="store_true")
+    parser.add_argument("--disable-iprof", action="store_true")
+    parser.add_argument("--enable-state-merging", action="store_true")
+    parser.add_argument("--enable-summaries", action="store_true")
+    parser.add_argument("--transaction-sequences",
+                        help="pinned function sequences, e.g. [[0xa9059cbb],[-1]]")
+
+
+def add_output_args(parser) -> None:
+    parser.add_argument("-o", "--outform", default="text",
+                        choices=["text", "markdown", "json", "jsonv2"])
+    parser.add_argument("-g", "--graph", help="write CFG html to this path")
+    parser.add_argument("-j", "--statespace-json",
+                        help="dump statespace json to this path")
+
+
+def configure_logging(verbosity: int) -> None:
+    levels = {
+        0: logging.NOTSET,
+        1: logging.CRITICAL,
+        2: logging.ERROR,
+        3: logging.WARNING,
+        4: logging.INFO,
+        5: logging.DEBUG,
+    }
+    logging.basicConfig(
+        level=levels.get(verbosity, logging.ERROR),
+        format="%(levelname)s: %(message)s",
+    )
+
+
+def load_code(parsed) -> str:
+    if parsed.code:
+        return parsed.code
+    if parsed.codefile:
+        with open(parsed.codefile) as handle:
+            return handle.read().strip()
+    raise CliError(
+        "no input: provide -c <hex>, -f <file>, -a <address>, or a .sol file"
+    )
+
+
+def _build_disassembler_and_load(parsed):
+    from mythril_tpu.core import MythrilDisassembler
+
+    eth = None
+    if getattr(parsed, "address", None):
+        from mythril_tpu.ethereum.interface.client import EthJsonRpc
+
+        rpc = getattr(parsed, "rpc", None)
+        eth = EthJsonRpc.from_cli(rpc, getattr(parsed, "rpctls", False))
+    disassembler = MythrilDisassembler(eth=eth)
+    if getattr(parsed, "address", None):
+        disassembler.load_from_address(parsed.address)
+    elif getattr(parsed, "solidity_files", None):
+        disassembler.load_from_solidity(parsed.solidity_files)
+    else:
+        disassembler.load_from_bytecode(
+            load_code(parsed), bin_runtime=getattr(parsed, "bin_runtime", False)
+        )
+    return disassembler
+
+
+def execute_command(parsed) -> int:
+    command = parsed.command
+    if command in (None, "version"):
+        print(f"mythril_tpu {__version__}")
+        return 0
+
+    if command == "list-detectors":
+        from mythril_tpu.analysis.module import ModuleLoader
+
+        for module in ModuleLoader().get_detection_modules():
+            print(f"{module.name}: {module.description}")
+        return 0
+
+    if command == "function-to-hash":
+        from mythril_tpu.utils.keccak import function_selector
+
+        print("0x" + function_selector(parsed.func_name).hex())
+        return 0
+
+    if command == "hash-to-address":
+        from mythril_tpu.support.signatures import SignatureDB
+
+        db = SignatureDB()
+        selector = parsed.hash
+        for sig in db.get(selector) or ["unknown"]:
+            print(sig)
+        return 0
+
+    if command == "disassemble":
+        disassembler = _build_disassembler_and_load(parsed)
+        contract = disassembler.contracts[0]
+        if contract.code_bytes:
+            print("Runtime Disassembly:\n")
+            print(contract.get_easm())
+        if contract.creation_code_bytes:
+            print("Disassembly:\n")
+            print(contract.get_creation_easm())
+        return 0
+
+    if command == "concolic":
+        from mythril_tpu.concolic.runner import run_concolic
+
+        with open(parsed.input) as handle:
+            concrete_data = json.load(handle)
+        branches = [int(b, 0) for b in parsed.branches.split(",")]
+        output = run_concolic(concrete_data, branches, parsed.solver_timeout)
+        print(json.dumps(output))
+        return 0
+
+    if command in ("analyze", "safe-functions"):
+        from mythril_tpu.core import MythrilAnalyzer
+
+        disassembler = _build_disassembler_and_load(parsed)
+        address = None
+        if getattr(parsed, "address", None):
+            address = int(parsed.address, 16)
+        analyzer = MythrilAnalyzer(
+            disassembler,
+            cmd_args=parsed,
+            strategy=parsed.strategy,
+            address=address,
+        )
+        modules = parsed.modules.split(",") if parsed.modules else None
+        if getattr(parsed, "graph", None):
+            html = analyzer.graph_html(enable_physics=False)
+            with open(parsed.graph, "w") as handle:
+                handle.write(html)
+            return 0
+        if getattr(parsed, "statespace_json", None):
+            dump = analyzer.dump_statespace()
+            with open(parsed.statespace_json, "w") as handle:
+                handle.write(dump)
+            return 0
+        report = analyzer.fire_lasers(
+            modules=modules, transaction_count=parsed.transaction_count
+        )
+        if command == "safe-functions":
+            _print_safe_functions(report, disassembler)
+            return 0
+        outform = parsed.outform
+        if outform == "text":
+            print(report.as_text())
+        elif outform == "markdown":
+            print(report.as_markdown())
+        elif outform == "json":
+            print(report.as_json())
+        else:
+            print(report.as_swc_standard_format())
+        return 1 if report.issues else 0
+
+    raise CliError(f"unknown command {command!r}")
+
+
+def _print_safe_functions(report, disassembler) -> None:
+    contract = disassembler.contracts[0]
+    flagged = {issue.function for issue in report.issues.values()}
+    entries = contract.disassembly.function_entries
+    safe = [
+        f"_function_0x{sel}" for sel in entries
+        if f"_function_0x{sel}" not in flagged
+    ]
+    print(f"{len(safe)} functions are deemed safe in this contract:")
+    for name in safe:
+        print(name)
+
+
+if __name__ == "__main__":
+    main()

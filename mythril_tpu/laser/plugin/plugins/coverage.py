@@ -1,0 +1,74 @@
+"""Coverage plugin — per-bytecode pc bitmap + per-tx new-coverage logging
+(reference laser/plugin/plugins/coverage/coverage_plugin.py:116)."""
+
+import logging
+from typing import Dict, List, Tuple
+
+from mythril_tpu.laser.plugin.interface import LaserPlugin, PluginBuilder
+
+log = logging.getLogger(__name__)
+
+
+class InstructionCoveragePlugin(LaserPlugin):
+    def __init__(self):
+        self.coverage: Dict[str, Tuple[int, List[bool]]] = {}
+        self.initial_coverage = 0
+        self.tx_id = 0
+
+    def initialize(self, symbolic_vm):
+        self.coverage = {}
+        self.tx_id = 0
+
+        def execute_state_hook(global_state):
+            code = global_state.environment.code.bytecode.hex()
+            if code not in self.coverage:
+                number_of_instrs = len(
+                    global_state.environment.code.instruction_list
+                )
+                self.coverage[code] = (
+                    number_of_instrs,
+                    [False] * number_of_instrs,
+                )
+            index = global_state.environment.code.index_of_address(
+                global_state.mstate.pc
+            )
+            if index is not None:
+                self.coverage[code][1][index] = True
+
+        def stop_sym_exec_hook():
+            for code, (total, seen) in self.coverage.items():
+                if total == 0:
+                    continue
+                covered = sum(seen)
+                log.info(
+                    "achieved %.2f%% coverage for code: %s...",
+                    covered / total * 100,
+                    code[:10],
+                )
+
+        def start_sym_trans_hook():
+            self.tx_id += 1
+            self.initial_coverage = self._total_covered()
+
+        def stop_sym_trans_hook():
+            end_coverage = self._total_covered()
+            log.info(
+                "number of new instructions covered in tx %d: %d",
+                self.tx_id,
+                end_coverage - self.initial_coverage,
+            )
+
+        symbolic_vm.register_laser_hooks("execute_state", execute_state_hook)
+        symbolic_vm.register_laser_hooks("stop_sym_exec", stop_sym_exec_hook)
+        symbolic_vm.register_laser_hooks("start_sym_trans", start_sym_trans_hook)
+        symbolic_vm.register_laser_hooks("stop_sym_trans", stop_sym_trans_hook)
+
+    def _total_covered(self) -> int:
+        return sum(sum(seen) for _total, seen in self.coverage.values())
+
+
+class CoveragePluginBuilder(PluginBuilder):
+    name = "coverage"
+
+    def __call__(self, *args, **kwargs):
+        return InstructionCoveragePlugin()

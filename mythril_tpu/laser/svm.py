@@ -179,6 +179,7 @@ class LaserEVM:
         """The message-call transaction loop (reference svm.py:252-309)."""
         from mythril_tpu.laser.transaction.symbolic import execute_message_call
 
+        pinned_sequences = self._parse_transaction_sequences()
         self._fire("start_execute_transactions")
         self.executed_transactions = True
         for i in range(self.transaction_count):
@@ -199,9 +200,35 @@ class LaserEVM:
                 i + 1, len(self.open_states),
             )
             self._fire("start_sym_trans")
-            execute_message_call(self, address)
+            func_hashes = (
+                pinned_sequences[i]
+                if pinned_sequences and i < len(pinned_sequences)
+                else None
+            )
+            execute_message_call(self, address, func_hashes=func_hashes)
             self._fire("stop_sym_trans")
         self._fire("stop_execute_transactions")
+
+    @staticmethod
+    def _parse_transaction_sequences():
+        """--transaction-sequences '[[0xa9059cbb],[-1]]' -> per-tx selector
+        lists (reference symbolic.py:74-100); -1 means the fallback."""
+        import ast
+
+        raw = args.transaction_sequences
+        if not raw:
+            return None
+        parsed = ast.literal_eval(raw) if isinstance(raw, str) else raw
+        sequences = []
+        for tx_entry in parsed:
+            hashes = []
+            for selector in tx_entry:
+                if selector == -1:
+                    hashes.append(-1)
+                else:
+                    hashes.append(int(selector).to_bytes(4, "big"))
+            sequences.append(hashes)
+        return sequences
 
     # -- the hot loop --------------------------------------------------------
 

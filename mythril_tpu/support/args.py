@@ -29,6 +29,7 @@ class _Args:
         self.enable_state_merging = False
         self.enable_summaries = False
         self.solver_backend = "cpu"            # cpu | tpu (shadowed by cpu)
+        self.transaction_sequences = None      # e.g. "[[0xa9059cbb],[-1]]"
 
     def reset(self):
         self.__init__()

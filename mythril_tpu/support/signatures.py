@@ -1,0 +1,112 @@
+"""4-byte function-selector database (reference mythril/support/signatures.py:225).
+
+sqlite-backed store at ~/.mythril_tpu/signatures.db; selectors learned from
+analyzed sources are added, lookups resolve `_function_0x...` names in
+reports. The online 4byte.directory lookup is gated off (no egress)."""
+
+import os
+import sqlite3
+import threading
+from typing import List, Optional
+
+from mythril_tpu.utils.keccak import function_selector
+
+_lock = threading.Lock()
+
+# common selectors so reports are readable out of the box
+_BUILTIN_SIGNATURES = [
+    "transfer(address,uint256)",
+    "transferFrom(address,address,uint256)",
+    "approve(address,uint256)",
+    "balanceOf(address)",
+    "totalSupply()",
+    "allowance(address,address)",
+    "owner()",
+    "kill()",
+    "withdraw()",
+    "withdraw(uint256)",
+    "deposit()",
+    "mint(address,uint256)",
+    "burn(uint256)",
+    "fallback()",
+    "setOwner(address)",
+    "claimOwnership()",
+    "transferOwnership(address)",
+    "initialize()",
+    "pause()",
+    "unpause()",
+]
+
+
+class SignatureDB:
+    _instance = None
+
+    def __new__(cls, enable_online_lookup: bool = False, path: Optional[str] = None):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+            cls._instance._init(path)
+        return cls._instance
+
+    def _init(self, path: Optional[str]):
+        base = os.environ.get(
+            "MYTHRIL_DIR", os.path.join(os.path.expanduser("~"), ".mythril_tpu")
+        )
+        os.makedirs(base, exist_ok=True)
+        self.path = path or os.path.join(base, "signatures.db")
+        with _lock, sqlite3.connect(self.path) as conn:
+            conn.execute(
+                "CREATE TABLE IF NOT EXISTS signatures "
+                "(byte_sig VARCHAR(10), text_sig VARCHAR(255),"
+                " PRIMARY KEY (byte_sig, text_sig))"
+            )
+        self.add_signatures(_BUILTIN_SIGNATURES)
+
+    def add(self, byte_sig: str, text_sig: str) -> None:
+        with _lock, sqlite3.connect(self.path) as conn:
+            conn.execute(
+                "INSERT OR IGNORE INTO signatures VALUES (?, ?)",
+                (byte_sig.lower(), text_sig),
+            )
+
+    def add_signatures(self, text_signatures: List[str]) -> None:
+        with _lock, sqlite3.connect(self.path) as conn:
+            for text_sig in text_signatures:
+                byte_sig = "0x" + function_selector(text_sig).hex()
+                conn.execute(
+                    "INSERT OR IGNORE INTO signatures VALUES (?, ?)",
+                    (byte_sig, text_sig),
+                )
+
+    def get(self, byte_sig: str) -> List[str]:
+        if not byte_sig.startswith("0x"):
+            byte_sig = "0x" + byte_sig
+        with _lock, sqlite3.connect(self.path) as conn:
+            rows = conn.execute(
+                "SELECT text_sig FROM signatures WHERE byte_sig = ?",
+                (byte_sig.lower(),),
+            ).fetchall()
+        return [row[0] for row in rows]
+
+    def import_solidity_file(self, file_path: str) -> None:
+        """Best-effort scrape of `function name(args)` declarations."""
+        import re
+
+        pattern = re.compile(r"function\s+([A-Za-z0-9_]+)\s*\(([^)]*)\)")
+        try:
+            with open(file_path) as handle:
+                source = handle.read()
+        except OSError:
+            return
+        for name, params in pattern.findall(source):
+            types = []
+            for param in params.split(","):
+                param = param.strip()
+                if not param:
+                    continue
+                types.append(_canonical_type(param.split()[0]))
+            self.add_signatures([f"{name}({','.join(types)})"])
+
+
+def _canonical_type(type_name: str) -> str:
+    aliases = {"uint": "uint256", "int": "int256", "byte": "bytes1"}
+    return aliases.get(type_name, type_name)
