@@ -1,0 +1,188 @@
+"""Benchmark: batched satisfiability throughput, device vs host CDCL.
+
+Measures the north-star secondary metric from BASELINE.md — SAT
+checks/sec/chip — on a deterministic batch of EVM-path-shaped QF_BV
+queries (function-selector dispatch + callvalue/calldata guards, the
+constraint mix JUMPI forks produce; ~20% unsatisfiable). Every query is
+lowered and bit-blasted by the production pipeline
+(smt/solver/frontend.py), then:
+
+  host   — the C++ CDCL (native/sat.cpp) solves queries one by one;
+  device — walksat.run_round_batch advances all queries at once (restarts
+           x queries in one jitted program of MXU matmuls); unsolved or
+           UNSAT queries fall back to the CDCL, and that fallback time is
+           charged to the device measurement.
+
+Prints ONE json line:
+  {"metric": "sat_checks_per_sec", "value": <device rate>,
+   "unit": "checks/s", "vs_baseline": <device rate / host CDCL rate>}
+
+The device leg runs in a subprocess with a timeout so a wedged TPU tunnel
+degrades to the host measurement (vs_baseline 1.0) instead of hanging.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+NUM_QUERIES = int(os.environ.get("BENCH_QUERIES", 32))
+RESTARTS = int(os.environ.get("BENCH_RESTARTS", 16))
+BITS = 64
+STEPS = 64
+MAX_ROUNDS = 12
+DEVICE_TIMEOUT_S = 900
+
+
+def build_queries(num_queries: int = NUM_QUERIES):
+    """Deterministic (num_vars, clauses, expect_sat) CNF batch."""
+    from mythril_tpu.smt import symbol_factory
+    from mythril_tpu.smt.solver.frontend import Solver
+
+    out = []
+    for qi in range(num_queries):
+        data = symbol_factory.BitVecSym(f"bench_data_{qi}", BITS)
+        value = symbol_factory.BitVecSym(f"bench_value_{qi}", BITS)
+        sender = symbol_factory.BitVecSym(f"bench_sender_{qi}", BITS)
+        solver = Solver()
+        selector = 0x41C0E1B5 ^ (qi * 0x01010101)
+        solver.add((data >> (BITS - 32)) == (selector % (1 << 32)))
+        solver.add(value < (1 << 40), sender != 0)
+        if qi % 5 == 4:  # infeasible path: contradictory balance guard
+            solver.add(value + 1 > (1 << 41), value < (1 << 39))
+        else:
+            solver.add(value + data != sender)
+        prep = solver._prepare([])
+        assert prep.trivial is None
+        out.append((prep.num_vars, prep.clauses))
+    return out
+
+
+def host_rate(queries):
+    from mythril_tpu.smt.solver import sat_backend
+
+    start = time.monotonic()
+    verdicts = []
+    for num_vars, clauses in queries:
+        status, _ = sat_backend.solve_cnf(num_vars, clauses,
+                                          timeout_seconds=60.0)
+        verdicts.append(status)
+    wall = time.monotonic() - start
+    return len(queries) / wall, wall, verdicts
+
+
+def device_rate(queries):
+    import jax
+    import numpy as np
+
+    from mythril_tpu.smt.solver import sat_backend
+    from mythril_tpu.tpu import pack, walksat
+    from mythril_tpu.tpu.backend import DeviceSolverBackend, \
+        _enable_compile_cache
+
+    _enable_compile_cache(jax)
+    v_pad = c_pad = 0
+    packed = [pack.PackedCNF(nv, cl) for nv, cl in queries]
+    for p in packed:
+        v_pad = max(v_pad, p.num_vars_pad)
+        c_pad = max(c_pad, p.num_clauses_pad)
+    q = len(packed)
+    a_pos = np.zeros((q, c_pad, v_pad), dtype=np.float32)
+    a_neg = np.zeros_like(a_pos)
+    clause_mask = np.zeros((q, c_pad), dtype=np.float32)
+    for qi, p in enumerate(packed):
+        a_pos[qi, : p.a_pos.shape[0], : p.a_pos.shape[1]] = p.a_pos
+        a_neg[qi, : p.a_neg.shape[0], : p.a_neg.shape[1]] = p.a_neg
+        clause_mask[qi, : p.clause_mask.shape[0]] = p.clause_mask
+
+    # the CPU platform only smoke-tests the path (driver runs this on TPU)
+    on_cpu = jax.default_backend() == "cpu"
+    steps = 8 if on_cpu else STEPS
+    max_rounds = 1 if on_cpu else MAX_ROUNDS
+
+    key = jax.random.PRNGKey(7)
+    keys = jax.random.split(key, q)
+    x = jax.random.bernoulli(
+        jax.random.PRNGKey(11), 0.5, (q, RESTARTS, v_pad)
+    ).astype(np.float32)
+
+    # warm the jit cache before timing (driver: first compile 20-40 s)
+    jax.block_until_ready(walksat.run_round_batch(
+        a_pos, a_neg, clause_mask, x, keys, steps=steps))
+
+    start = time.monotonic()
+    solved = np.zeros((q,), dtype=bool)
+    for round_i in range(max_rounds):
+        keys = jax.vmap(lambda k: jax.random.fold_in(k, round_i))(keys)
+        x, found = walksat.run_round_batch(
+            a_pos, a_neg, clause_mask, x, keys, steps=steps)
+        solved |= np.asarray(found).any(axis=1)
+        if solved.all():
+            break
+    found_np = np.asarray(found)
+    x_np = np.asarray(x)
+    checker = DeviceSolverBackend._honors
+    verdicts = []
+    for qi, p in enumerate(packed):
+        bits = None
+        if solved[qi] and found_np[qi].any():
+            row = int(np.argmax(found_np[qi]))
+            bits = pack.model_bits_from_assignment(
+                x_np[qi, row], queries[qi][0])
+            if not checker(bits, queries[qi][1]):
+                bits = None
+        if bits is not None:
+            verdicts.append("sat")
+        else:  # unsolved or UNSAT: the CDCL oracle decides (charged here)
+            status, _ = sat_backend.solve_cnf(
+                queries[qi][0], queries[qi][1], timeout_seconds=60.0)
+            verdicts.append(status)
+    wall = time.monotonic() - start
+    return len(queries) / wall, wall, verdicts, int(solved.sum())
+
+
+def child_main():
+    queries = build_queries()
+    rate, wall, verdicts, device_solved = device_rate(queries)
+    print(json.dumps({
+        "rate": rate, "wall": wall, "verdicts": verdicts,
+        "device_solved": device_solved,
+    }))
+
+
+def main():
+    queries = build_queries()
+    h_rate, h_wall, h_verdicts = host_rate(queries)
+
+    result = None
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child"],
+            capture_output=True, text=True, timeout=DEVICE_TIMEOUT_S,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        if proc.returncode == 0 and proc.stdout.strip():
+            result = json.loads(proc.stdout.strip().splitlines()[-1])
+    except (subprocess.SubprocessError, OSError, ValueError):
+        result = None
+
+    if result is not None and result["verdicts"] == h_verdicts:
+        value = result["rate"]
+        vs = result["rate"] / h_rate if h_rate else 0.0
+    else:  # device leg unavailable (wedged tunnel) or verdict mismatch
+        value = h_rate
+        vs = 1.0
+    print(json.dumps({
+        "metric": "sat_checks_per_sec",
+        "value": round(value, 2),
+        "unit": "checks/s",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    if "--child" in sys.argv:
+        child_main()
+    else:
+        main()

@@ -34,6 +34,8 @@ class EtherThief(DetectionModule):
 
     def _analyze_state(self, state):
         instruction = state.get_current_instruction()
+        if instruction is None:  # CALL was the last instruction of the code
+            return []
 
         constraints = []
         world_state = state.world_state

@@ -1,5 +1,14 @@
-"""UserAssertions — SWC-110 solidity 0.8 Panic / user-defined assert messages
-(reference analysis/module/modules/user_assertions.py:131)."""
+"""UserAssertions — SWC-110 user-defined assertion signals
+(reference analysis/module/modules/user_assertions.py:131).
+
+Three signals, all deliberate assertion mechanisms (a plain
+`require(cond, "reason")` revert is NOT one — flagging those would report
+every guard clause in every contract):
+
+* solidity >=0.8 `assert` — REVERT carrying `Panic(0x01)`;
+* `emit AssertionFailed(string)` — LOG1 with the well-known topic;
+* hevm-style property failure — MSTORE of the 0xcafecafe... marker word.
+"""
 
 import logging
 
@@ -12,10 +21,15 @@ from mythril_tpu.smt.solver.frontend import SolverTimeOutException, UnsatError
 
 log = logging.getLogger(__name__)
 
-# Panic(uint256) selector and assertion-failure code 0x01
+# Panic(uint256) selector; assertion failure is code 0x01
 PANIC_SELECTOR = 0x4E487B71
-# Error(string) selector for revert reasons
-ERROR_SELECTOR = 0x08C379A0
+# keccak("AssertionFailed(string)") — the MythX/hevm assertion event topic
+ASSERTION_FAILED_TOPIC = (
+    0xB42604CB105A16C8F6DB8A41E6B00C0C1B4826465E8BC504B3EB3E88B3E6A4A0
+)
+# hevm writes this marker word before failing a property
+HEVM_MARKER = 0xCAFECAFE
+HEVM_MARKER_PREFIX = "0xcafecafecafecafecafecafecafecafecafecafe"
 
 
 class UserAssertions(DetectionModule):
@@ -23,31 +37,17 @@ class UserAssertions(DetectionModule):
     swc_id = ASSERT_VIOLATION
     description = "A user-provided assertion failed."
     entry_point = EntryPoint.CALLBACK
-    pre_hooks = ["REVERT"]
+    pre_hooks = ["REVERT", "LOG1", "MSTORE"]
 
     def _analyze_state(self, state):
-        offset, length = state.mstate.stack[-1], state.mstate.stack[-2]
-        offset_c = concrete_or_none(offset)
-        length_c = concrete_or_none(length)
-        if offset_c is None or length_c is None or length_c < 4:
-            return []
-        word = state.mstate.memory.get_word_at(offset_c)
-        selector_bv = concrete_or_none(word)
-        if selector_bv is None:
-            return []
-        selector = selector_bv >> 224
-        if selector == PANIC_SELECTOR:
-            if length_c < 36:
-                return []
-            code_bv = concrete_or_none(
-                state.mstate.memory.get_word_at(offset_c + 4)
-            )
-            if code_bv != 1:  # Panic(0x01) == assert failure
-                return []
-            message = "An assertion violation was triggered (Panic 0x01)."
-        elif selector == ERROR_SELECTOR:
-            message = "A user-provided string assertion failed."
+        opcode = state.get_current_instruction().opcode
+        if opcode == "REVERT":
+            message = self._panic_message(state)
+        elif opcode == "LOG1":
+            message = self._assertion_event_message(state)
         else:
+            message = self._hevm_marker_message(state)
+        if message is None:
             return []
         try:
             transaction_sequence = get_transaction_sequence(
@@ -74,3 +74,42 @@ class UserAssertions(DetectionModule):
                 transaction_sequence=transaction_sequence,
             )
         ]
+
+    @staticmethod
+    def _panic_message(state):
+        """solidity 0.8 assert: REVERT with Panic(0x01) calldata."""
+        offset, length = state.mstate.stack[-1], state.mstate.stack[-2]
+        offset_c = concrete_or_none(offset)
+        length_c = concrete_or_none(length)
+        if offset_c is None or length_c is None or length_c < 36:
+            return None
+        word = state.mstate.memory.get_word_at(offset_c)
+        selector_bv = concrete_or_none(word)
+        if selector_bv is None or (selector_bv >> 224) != PANIC_SELECTOR:
+            return None
+        code = concrete_or_none(state.mstate.memory.get_word_at(offset_c + 4))
+        if code != 1:
+            return None
+        return "An assertion violation was triggered (Panic 0x01)."
+
+    @staticmethod
+    def _assertion_event_message(state):
+        """emit AssertionFailed(string): LOG1 with the well-known topic."""
+        if len(state.mstate.stack) < 3:
+            return None
+        topic = concrete_or_none(state.mstate.stack[-3])
+        if topic != ASSERTION_FAILED_TOPIC:
+            return None
+        return "A user-provided assertion failed (AssertionFailed event)."
+
+    @staticmethod
+    def _hevm_marker_message(state):
+        """hevm property failure: MSTORE of the cafecafe... marker word."""
+        if len(state.mstate.stack) < 2:
+            return None
+        value = concrete_or_none(state.mstate.stack[-2])
+        if value is None:
+            return None
+        if HEVM_MARKER_PREFIX not in hex(value)[:126]:
+            return None
+        return f"Failed property id {value & 0xFFFF}"

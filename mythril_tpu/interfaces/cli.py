@@ -164,7 +164,10 @@ def _build_disassembler_and_load(parsed):
 
     eth = None
     if getattr(parsed, "address", None):
-        from mythril_tpu.ethereum.interface.client import EthJsonRpc
+        try:
+            from mythril_tpu.ethereum.interface.client import EthJsonRpc
+        except ImportError as error:
+            raise CliError(f"RPC support unavailable: {error}")
 
         rpc = getattr(parsed, "rpc", None)
         eth = EthJsonRpc.from_cli(rpc, getattr(parsed, "rpctls", False))
@@ -172,7 +175,10 @@ def _build_disassembler_and_load(parsed):
     if getattr(parsed, "address", None):
         disassembler.load_from_address(parsed.address)
     elif getattr(parsed, "solidity_files", None):
-        disassembler.load_from_solidity(parsed.solidity_files)
+        try:
+            disassembler.load_from_solidity(parsed.solidity_files)
+        except ImportError as error:
+            raise CliError(f"solidity support unavailable: {error}")
     else:
         disassembler.load_from_bytecode(
             load_code(parsed), bin_runtime=getattr(parsed, "bin_runtime", False)
@@ -220,7 +226,10 @@ def execute_command(parsed) -> int:
         return 0
 
     if command == "concolic":
-        from mythril_tpu.concolic.runner import run_concolic
+        try:
+            from mythril_tpu.concolic.runner import run_concolic
+        except ImportError as error:
+            raise CliError(f"concolic support unavailable: {error}")
 
         with open(parsed.input) as handle:
             concrete_data = json.load(handle)
@@ -276,11 +285,19 @@ def execute_command(parsed) -> int:
 def _print_safe_functions(report, disassembler) -> None:
     contract = disassembler.contracts[0]
     flagged = {issue.function for issue in report.issues.values()}
-    entries = contract.disassembly.function_entries
-    safe = [
-        f"_function_0x{sel}" for sel in entries
-        if f"_function_0x{sel}" not in flagged
-    ]
+    try:
+        from mythril_tpu.support.signatures import SignatureDB
+
+        sig_db = SignatureDB()
+    except Exception:
+        sig_db = None
+    safe = []
+    for sel in contract.disassembly.function_entries:
+        raw = f"_function_0x{sel}"
+        # issues carry DB-resolved names; compare both spellings
+        resolved = (sig_db.get(f"0x{sel}") or [None])[0] if sig_db else None
+        if raw not in flagged and (resolved is None or resolved not in flagged):
+            safe.append(resolved or raw)
     print(f"{len(safe)} functions are deemed safe in this contract:")
     for name in safe:
         print(name)

@@ -20,7 +20,10 @@ class InstructionCoveragePlugin(LaserPlugin):
         self.tx_id = 0
 
         def execute_state_hook(global_state):
-            code = global_state.environment.code.bytecode.hex()
+            # keyed by the precomputed bytecode hash: the hook runs for
+            # every instruction, hex-encoding the bytecode here would be
+            # O(code size) in the engine's hottest loop
+            code = global_state.environment.code.bytecode_hash
             if code not in self.coverage:
                 number_of_instrs = len(
                     global_state.environment.code.instruction_list
@@ -41,9 +44,9 @@ class InstructionCoveragePlugin(LaserPlugin):
                     continue
                 covered = sum(seen)
                 log.info(
-                    "achieved %.2f%% coverage for code: %s...",
+                    "achieved %.2f%% coverage for code hash: %s...",
                     covered / total * 100,
-                    code[:10],
+                    code[:5].hex(),
                 )
 
         def start_sym_trans_hook():

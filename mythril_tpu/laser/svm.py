@@ -311,10 +311,14 @@ class LaserEVM:
         self._record_state(global_state, instr)
         global_state.mstate.depth += 1
 
-        for hook in self.pre_hooks[op_name]:
-            hook(global_state)
-        for hook in self.instr_pre_hook[op_name]:
-            hook(global_state)
+        try:
+            for hook in self.pre_hooks[op_name]:
+                hook(global_state)
+            for hook in self.instr_pre_hook[op_name]:
+                hook(global_state)
+        except PluginSkipState:
+            # a pruner (e.g. dependency_pruner) vetoed this state
+            return [], None
 
         try:
             new_states = instructions.execute(global_state, instr)
@@ -333,13 +337,17 @@ class LaserEVM:
         except TransactionEndSignal as signal:
             new_states = self._end_transaction(global_state, signal, op_name)
 
-        for hook in self.post_hooks[op_name]:
-            for state in new_states:
-                hook(state)
-        for hook in self.instr_post_hook[op_name]:
-            for state in new_states:
-                hook(state)
-        return new_states, op_name
+        kept = []
+        for state in new_states:
+            try:
+                for hook in self.post_hooks[op_name]:
+                    hook(state)
+                for hook in self.instr_post_hook[op_name]:
+                    hook(state)
+                kept.append(state)
+            except PluginSkipState:
+                continue
+        return kept, op_name
 
     def _implicit_stop(self, global_state):
         transaction = global_state.current_transaction

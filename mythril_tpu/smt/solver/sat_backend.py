@@ -82,8 +82,43 @@ def solve_cnf(
 
     Returns (status, model) where model[v] is the boolean of var v (1-based),
     or None unless SAT.
+
+    With `--solver-backend=tpu` the batched device local-search solver gets
+    the first slice of the budget (it can only return SAT-with-model; every
+    model is re-checked on host). The CDCL remains the UNSAT prover and
+    ground-truth oracle.
     """
     assumptions = list(assumptions)
+    from mythril_tpu.support.args import args as _args
+
+    if _args.solver_backend == "tpu" and not conflict_budget:
+        import time as _time
+
+        start = _time.monotonic()
+        # Local search cannot prove UNSAT, and feasibility queries are
+        # mostly UNSAT: let a conflict-budgeted CDCL probe settle the easy
+        # ones first; only queries it can't crack go to the device.
+        probe_status, probe_model = solve_cnf(
+            num_vars, clauses, assumptions,
+            timeout_seconds=min(0.5, timeout_seconds or 0.5),
+            conflict_budget=20000,
+        )
+        if probe_status != UNKNOWN:
+            return probe_status, probe_model
+        try:
+            from mythril_tpu.tpu.backend import get_device_backend
+
+            device_budget = min(2.0, timeout_seconds * 0.4) \
+                if timeout_seconds else 2.0
+            bits = get_device_backend().try_solve(
+                num_vars, clauses, assumptions, budget_seconds=device_budget)
+            if bits is not None:
+                return SAT, bits
+        except ImportError:  # jax/numpy absent: CDCL-only mode
+            pass
+        if timeout_seconds:
+            timeout_seconds = max(
+                0.05, timeout_seconds - (_time.monotonic() - start))
     lib = _get_native()
     if lib is not None:
         return _solve_native(lib, num_vars, clauses, assumptions,

@@ -1,0 +1,18 @@
+"""TPU-native batched satisfiability (the point of the project).
+
+The word-level frontend (smt/solver/frontend.py) lowers QF_ABV path
+constraints to CNF; this package packs those clauses into fixed-shape
+device tensors and searches for models with a batched stochastic local
+search whose inner loop is pure MXU work (clause evaluation and make/break
+scoring as [restarts, clauses] @ [clauses, vars] matmuls).
+
+Local search is a SAT-finder, not an UNSAT-prover: a found model is
+validated on the host against the original word-level constraints
+(frontend._reconstruct), and queries the device cannot crack fall back to
+the C++ CDCL backend — the ground-truth oracle in the role the reference
+keeps z3 for (reference mythril/support/model.py:63-125).
+
+Select with `--solver-backend=tpu` (support/args.py `args.solver_backend`).
+"""
+
+from mythril_tpu.tpu.backend import DeviceSolverBackend, get_device_backend  # noqa: F401

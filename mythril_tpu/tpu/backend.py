@@ -1,0 +1,174 @@
+"""Host-side driver for the device local-search solver.
+
+try_solve() packs a CNF query, runs rounds of the jitted kernel until a
+model is found or the budget lapses, and returns frontend-compatible model
+bits (or None — caller falls back to the C++ CDCL, which alone can prove
+UNSAT). Assumptions become unit clauses, so returned models always honor
+them.
+
+The backend is process-global (jit/pack caches are expensive); statistics
+feed bench.py and SolverStatistics.
+"""
+
+import logging
+import os
+import time
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from mythril_tpu.tpu import pack
+
+log = logging.getLogger(__name__)
+
+_backend = None
+_cache_enabled = False
+
+
+def _enable_compile_cache(jax) -> None:
+    """Persist XLA executables across processes; first-compile latency for a
+    shape bucket is seconds, every later run (and every CLI invocation)
+    hits the cache."""
+    global _cache_enabled
+    if _cache_enabled:
+        return
+    try:
+        cache_dir = os.environ.get(
+            "MYTHRIL_TPU_JIT_CACHE",
+            os.path.join(os.path.expanduser("~"), ".cache", "mythril_tpu_xla"),
+        )
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass
+    _cache_enabled = True
+
+
+def get_device_backend() -> "DeviceSolverBackend":
+    global _backend
+    if _backend is None:
+        _backend = DeviceSolverBackend()
+    return _backend
+
+
+class DeviceSolverBackend:
+    def __init__(self, num_restarts: Optional[int] = None,
+                 steps_per_round: int = 64, noise: float = 0.35):
+        # explicit constructor arg wins; the env var only sets the default
+        if num_restarts is None:
+            num_restarts = int(os.environ.get("MYTHRIL_TPU_RESTARTS", 64))
+        self.num_restarts = num_restarts
+        self.steps_per_round = steps_per_round
+        self.noise = noise
+        self.queries = 0
+        self.sat_found = 0
+        self.fallbacks = 0
+        self.device_seconds = 0.0
+        self.flips = 0
+        self._jax = None
+        self._seed = 0
+
+    def _modules(self):
+        if self._jax is None:
+            import jax
+
+            _enable_compile_cache(jax)
+            from mythril_tpu.tpu import walksat
+
+            self._jax = (jax, walksat)
+        return self._jax
+
+    def available(self) -> bool:
+        try:
+            self._modules()
+            return True
+        except Exception:  # jax missing/broken: CDCL-only mode
+            return False
+
+    def try_solve(
+        self,
+        num_vars: int,
+        clauses: Sequence[Tuple[int, ...]],
+        assumptions: Sequence[int] = (),
+        budget_seconds: float = 2.0,
+    ) -> Optional[List[bool]]:
+        """Search for a model on device; None if not found in budget."""
+        full = [tuple(c) for c in clauses] + [(a,) for a in assumptions]
+        if num_vars == 0 or not pack.fits_dense(num_vars, full):
+            return None
+        if any(len(c) == 0 for c in full):
+            return None  # trivially unsat; let CDCL report it
+        self.queries += 1
+        start = time.monotonic()
+        try:
+            jax, walksat = self._modules()
+        except Exception:
+            return None
+        deadline = start + budget_seconds
+
+        packed = pack.PackedCNF(num_vars, full)
+        a_pos = jax.numpy.asarray(packed.a_pos)
+        a_neg = jax.numpy.asarray(packed.a_neg)
+        clause_mask = jax.numpy.asarray(packed.clause_mask)
+
+        self._seed += 1
+        key = jax.random.PRNGKey(self._seed)
+        key, init_key = jax.random.split(key)
+        x = walksat.init_assignments(
+            init_key, self.num_restarts, packed.num_vars_pad)
+
+        rounds = 0
+        while True:
+            key, round_key = jax.random.split(key)
+            x, found = walksat.run_round(
+                a_pos, a_neg, clause_mask, x, round_key,
+                steps=self.steps_per_round, noise=self.noise,
+            )
+            rounds += 1
+            found_host = np.asarray(found)
+            self.flips += self.num_restarts * self.steps_per_round
+            if found_host.any():
+                row = int(np.argmax(found_host))
+                bits = pack.model_bits_from_assignment(
+                    np.asarray(x[row]), num_vars)
+                if self._honors(bits, full):
+                    self.sat_found += 1
+                    self.device_seconds += time.monotonic() - start
+                    return bits
+                log.warning("device model failed host clause check; "
+                            "falling back to CDCL")
+                break
+            if time.monotonic() >= deadline:
+                break
+            # periodic restart: re-randomize the worst half of the batch
+            if rounds % 8 == 0:
+                key, re_key = jax.random.split(key)
+                fresh = walksat.init_assignments(
+                    re_key, self.num_restarts, packed.num_vars_pad)
+                half = self.num_restarts // 2
+                x = x.at[:half].set(fresh[:half])
+        self.fallbacks += 1
+        self.device_seconds += time.monotonic() - start
+        return None
+
+    @staticmethod
+    def _honors(bits: List[bool], clauses: Sequence[Tuple[int, ...]]) -> bool:
+        for clause in clauses:
+            if not any(bits[lit] if lit > 0 else not bits[-lit]
+                       for lit in clause):
+                return False
+        return True
+
+    def stats(self) -> dict:
+        return {
+            "queries": self.queries,
+            "sat_found": self.sat_found,
+            "fallbacks": self.fallbacks,
+            "device_seconds": round(self.device_seconds, 4),
+            "flips": self.flips,
+            "flips_per_second": (
+                round(self.flips / self.device_seconds)
+                if self.device_seconds else 0
+            ),
+        }

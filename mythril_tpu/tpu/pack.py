@@ -1,0 +1,106 @@
+"""CNF -> fixed-shape device tensors.
+
+XLA compiles one program per tensor shape, so problems are padded up to
+bucket sizes (powers of two) to keep the jit cache small. Two encodings:
+
+* dense incidence matrices A_pos/A_neg `[C, V]` in {0,1} — feeds the
+  matmul-based local-search kernel (walksat.py); memory O(C*V), gated by
+  `fits_dense`.
+* padded literal lists `[C, K]` — compact, used for batched clause
+  evaluation of candidate models (quick-sat probes) and as the seed for a
+  future Pallas sparse kernel.
+
+Literals are DIMACS-signed ints (var 1-based); 0 is padding.
+"""
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# Dense-path capacity: A matrices are 2 * C * V f32 bytes on device.
+# On an accelerator 8192 * 32768 * 4 B * 2 = 2 GiB — fine on a v5e
+# (16 GiB HBM); on the host CPU (tests, 1 core) keep the matmuls small.
+_ACCEL_CAPS = (8192, 32768)
+_CPU_CAPS = (1024, 8192)
+
+
+def dense_caps() -> Tuple[int, int]:
+    try:
+        import jax
+
+        if jax.default_backend() != "cpu":
+            return _ACCEL_CAPS
+    except Exception:
+        pass
+    return _CPU_CAPS
+
+
+def _bucket(n: int, floor: int, cap: int) -> int:
+    size = floor
+    while size < n:
+        size *= 2
+    return min(size, cap) if size <= cap else size
+
+
+class PackedCNF:
+    """One CNF problem padded to (num_vars_pad, num_clauses_pad)."""
+
+    __slots__ = ("num_vars", "num_clauses", "num_vars_pad", "num_clauses_pad",
+                 "a_pos", "a_neg", "clause_mask")
+
+    def __init__(self, num_vars: int, clauses: Sequence[Sequence[int]],
+                 var_floor: int = 128, clause_floor: int = 256):
+        self.num_vars = num_vars
+        self.num_clauses = len(clauses)
+        var_cap, clause_cap = dense_caps()
+        self.num_vars_pad = _bucket(max(num_vars, 1), var_floor, var_cap)
+        self.num_clauses_pad = _bucket(max(len(clauses), 1), clause_floor,
+                                       clause_cap)
+        v_pad, c_pad = self.num_vars_pad, self.num_clauses_pad
+        a_pos = np.zeros((c_pad, v_pad), dtype=np.float32)
+        a_neg = np.zeros((c_pad, v_pad), dtype=np.float32)
+        for ci, clause in enumerate(clauses):
+            for lit in clause:
+                var = abs(lit) - 1  # column 0 = var 1
+                if lit > 0:
+                    a_pos[ci, var] = 1.0
+                else:
+                    a_neg[ci, var] = 1.0
+        self.a_pos = a_pos
+        self.a_neg = a_neg
+        mask = np.zeros((c_pad,), dtype=np.float32)
+        mask[: len(clauses)] = 1.0
+        self.clause_mask = mask
+
+    @property
+    def shape_key(self) -> Tuple[int, int]:
+        return (self.num_clauses_pad, self.num_vars_pad)
+
+
+def fits_dense(num_vars: int, clauses: Sequence[Sequence[int]]) -> bool:
+    var_cap, clause_cap = dense_caps()
+    return num_vars <= var_cap and len(clauses) <= clause_cap
+
+
+def pack_literal_lists(
+    clauses: Sequence[Sequence[int]],
+    max_len: Optional[int] = None,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Clauses as a padded `[C, K]` literal matrix + `[C]` length vector."""
+    if max_len is None:
+        max_len = max((len(c) for c in clauses), default=1)
+    lits = np.zeros((len(clauses), max_len), dtype=np.int32)
+    lengths = np.zeros((len(clauses),), dtype=np.int32)
+    for ci, clause in enumerate(clauses):
+        lits[ci, : len(clause)] = clause
+        lengths[ci] = len(clause)
+    return lits, lengths
+
+
+def model_bits_from_assignment(assignment: np.ndarray,
+                               num_vars: int) -> List[bool]:
+    """Device assignment row `[V_pad]` -> frontend bits list (1-based)."""
+    bits = [False] * (num_vars + 1)
+    for var in range(1, num_vars + 1):
+        bits[var] = bool(assignment[var - 1] > 0.5)
+    return bits

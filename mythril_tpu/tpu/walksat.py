@@ -1,0 +1,120 @@
+"""Batched stochastic local search over dense CNF incidence matrices.
+
+One jitted "round" advances R independent restarts by S flips. Everything
+in the inner loop is dense linear algebra over fixed shapes, so XLA maps
+it onto the MXU and fuses the elementwise glue:
+
+  true_counts[r,c] = X[r] @ (A_pos - A_neg)[c] + colsum(A_neg)[c]
+  clause c is satisfied        iff true_counts >= 1
+  clause c is critical         iff true_counts == 1   (one flip breaks it)
+  break[r,v] = #critical clauses whose single true literal sits on v
+  make[r,v]  = #unsat clauses that flipping v would satisfy
+
+Flip choice per restart: with probability `noise` a random variable drawn
+from the unsat-occurrence distribution (WalkSAT), otherwise the variable
+maximizing make-break with Gumbel tie-breaking (GSAT). Solved restarts are
+frozen so their assignment survives to extraction.
+
+No data-dependent shapes, no Python control flow inside jit — the round is
+a lax.scan and the caller loops rounds on the host, checking the `found`
+flags between rounds (the only host<->device sync point).
+"""
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e9
+
+
+def _step(carry, step_key, a_pos, a_neg, a_diff_t, neg_colsum, clause_mask,
+          noise):
+    x, found = carry
+    # [R, C] satisfied-literal counts per clause (exact small ints in f32)
+    true_counts = x @ a_diff_t + neg_colsum
+    live = clause_mask[None, :]
+    unsat = live * (true_counts < 0.5)
+    newly_found = jnp.sum(unsat, axis=1) < 0.5
+    found = found | newly_found
+
+    critical = live * (jnp.abs(true_counts - 1.0) < 0.5)
+    # matmuls [R,C]@[C,V]: make/break scores + unsat-occurrence weights
+    crit_pos = critical @ a_pos
+    crit_neg = critical @ a_neg
+    unsat_pos = unsat @ a_pos
+    unsat_neg = unsat @ a_neg
+    breaks = x * crit_pos + (1.0 - x) * crit_neg
+    makes = (1.0 - x) * unsat_pos + x * unsat_neg
+    occurrence = unsat_pos + unsat_neg
+    candidate = occurrence > 0.5
+
+    k_greedy, k_rand, k_choice = jax.random.split(step_key, 3)
+    score = jnp.where(candidate, makes - breaks, NEG_INF)
+    gumbel = jax.random.gumbel(k_greedy, score.shape) * 0.01
+    v_greedy = jnp.argmax(score + gumbel, axis=1)
+    logits = jnp.where(candidate, jnp.log(occurrence + 1e-6), NEG_INF)
+    v_rand = jax.random.categorical(k_rand, logits, axis=1)
+    use_rand = jax.random.bernoulli(k_choice, noise, (x.shape[0],))
+    v_flip = jnp.where(use_rand, v_rand, v_greedy)
+
+    flip = jax.nn.one_hot(v_flip, x.shape[1], dtype=x.dtype)
+    flip = flip * (1.0 - found[:, None])  # freeze solved restarts
+    x = x * (1.0 - flip) + (1.0 - x) * flip
+    return (x, found), None
+
+
+@functools.partial(jax.jit, static_argnames=("steps", "noise"))
+def run_round(a_pos: jnp.ndarray, a_neg: jnp.ndarray,
+              clause_mask: jnp.ndarray, x: jnp.ndarray, key: jnp.ndarray,
+              steps: int = 64, noise: float = 0.35
+              ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Advance all restarts by `steps` flips; returns (x, found)."""
+    a_diff_t = (a_pos - a_neg).T
+    neg_colsum = jnp.sum(a_neg, axis=1)[None, :]
+    step = functools.partial(
+        _step, a_pos=a_pos, a_neg=a_neg, a_diff_t=a_diff_t,
+        neg_colsum=neg_colsum, clause_mask=clause_mask, noise=noise,
+    )
+    keys = jax.random.split(key, steps)
+    # derive found0 from x (not a fresh constant) so its varying-manual-axes
+    # match the carry output under shard_map (see shard_map scan-vma docs)
+    found0 = jnp.sum(x, axis=1) < -1.0
+    # settle `found` for the initial assignment too (step 0 checks first)
+    (x, found), _ = lax.scan(lambda c, k: step(c, k), (x, found0), keys)
+    return x, found
+
+
+def init_assignments(key: jnp.ndarray, num_restarts: int,
+                     num_vars_pad: int) -> jnp.ndarray:
+    return jax.random.bernoulli(
+        key, 0.5, (num_restarts, num_vars_pad)
+    ).astype(jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("steps", "noise"))
+def run_round_batch(a_pos: jnp.ndarray, a_neg: jnp.ndarray,
+                    clause_mask: jnp.ndarray, x: jnp.ndarray,
+                    keys: jnp.ndarray, steps: int = 64, noise: float = 0.35
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Many independent queries at once: `a_pos`/`a_neg` are [Q, C, V],
+    `clause_mask` [Q, C], `x` [Q, R, V], `keys` [Q, 2] — the fan-out unit
+    for sibling-path feasibility checks (SURVEY §7.6). The Q axis is the
+    natural data-parallel shard across a TPU slice; R shards model-parallel
+    within a query (see __graft_entry__.dryrun_multichip)."""
+    return jax.vmap(
+        lambda ap, an, cm, xx, kk: run_round(ap, an, cm, xx, kk,
+                                             steps=steps, noise=noise)
+    )(a_pos, a_neg, clause_mask, x, keys)
+
+
+@jax.jit
+def check_assignments(a_pos: jnp.ndarray, a_neg: jnp.ndarray,
+                      clause_mask: jnp.ndarray,
+                      x: jnp.ndarray) -> jnp.ndarray:
+    """[R] bool: does each assignment satisfy every live clause?"""
+    true_counts = x @ (a_pos - a_neg).T + jnp.sum(a_neg, axis=1)[None, :]
+    unsat = clause_mask[None, :] * (true_counts < 0.5)
+    return jnp.sum(unsat, axis=1) < 0.5

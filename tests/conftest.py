@@ -5,7 +5,13 @@ separately dry-runs the multichip path via __graft_entry__.dryrun_multichip)."""
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# HARD assignment, not setdefault: the ambient environment may pin
+# JAX_PLATFORMS=axon (the real-TPU tunnel); tests must never claim the chip
+# (a wedged grant blocks every later jax process on the machine).
+os.environ["JAX_PLATFORMS"] = "cpu"
+# small restart batch: keeps device-solver jit shapes tiny on the CPU
+# platform (hard assignment — ambient env must not win here either)
+os.environ["MYTHRIL_TPU_RESTARTS"] = "16"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
