@@ -27,8 +27,7 @@ PANIC_SELECTOR = 0x4E487B71
 ASSERTION_FAILED_TOPIC = (
     0xB42604CB105A16C8F6DB8A41E6B00C0C1B4826465E8BC504B3EB3E88B3E6A4A0
 )
-# hevm writes this marker word before failing a property
-HEVM_MARKER = 0xCAFECAFE
+# hevm writes a word starting with this marker before failing a property
 HEVM_MARKER_PREFIX = "0xcafecafecafecafecafecafecafecafecafecafe"
 
 
@@ -110,6 +109,6 @@ class UserAssertions(DetectionModule):
         value = concrete_or_none(state.mstate.stack[-2])
         if value is None:
             return None
-        if HEVM_MARKER_PREFIX not in hex(value)[:126]:
+        if not hex(value).startswith(HEVM_MARKER_PREFIX):
             return None
         return f"Failed property id {value & 0xFFFF}"

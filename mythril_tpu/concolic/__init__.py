@@ -1,0 +1,4 @@
+"""Concolic mode: concrete replay -> trace -> branch flipping
+(reference mythril/concolic/, 193 LoC)."""
+
+from mythril_tpu.concolic.runner import concolic_execution, run_concolic  # noqa: F401

@@ -1,0 +1,2 @@
+"""On-chain access: minimal JSON-RPC client
+(reference mythril/ethereum/interface/rpc/)."""

@@ -117,9 +117,15 @@ class WorldState:
             dup._accounts[addr] = account.clone(balances=dup.balances)
         dup.constraints = self.constraints.copy()
         dup.transaction_sequence = list(self.transaction_sequence)
+        # per-path mutable metadata (traces, dependency maps) must not leak
+        # between forks: prefer this codebase's clone() convention, fall
+        # back to __copy__ (same form as GlobalState.copy)
+        import copy as _copy
+
         dup.annotations = [
-            a for a in self.annotations
-        ]  # annotations shared (persisted metadata)
+            a.clone() if hasattr(a, "clone") else _copy.copy(a)
+            for a in self.annotations
+        ]
         dup.node = self.node
         return dup
 

@@ -114,7 +114,9 @@ def solve_cnf(
                 num_vars, clauses, assumptions, budget_seconds=device_budget)
             if bits is not None:
                 return SAT, bits
-        except ImportError:  # jax/numpy absent: CDCL-only mode
+        except Exception:
+            # jax absent OR broken at runtime (device OOM, compile error,
+            # wedged transport): degrade to CDCL-only, never crash the run
             pass
         if timeout_seconds:
             timeout_seconds = max(

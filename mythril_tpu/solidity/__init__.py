@@ -1,0 +1,2 @@
+"""Solidity frontend: solc standard-json compilation, source mapping,
+AST feature extraction (reference mythril/solidity/)."""

@@ -1,0 +1,99 @@
+"""Per-function feature extraction from the solc AST
+(reference mythril/solidity/features.py:234) — the feature vector feeding
+the transaction-sequence prioritizer (laser/tx_prioritiser.py).
+
+Walks the standard-json AST of each function and records the presence of
+state-changing or guard constructs.
+"""
+
+from typing import Dict, List
+
+
+FEATURES = (
+    "contains_selfdestruct",
+    "contains_call",
+    "contains_delegatecall",
+    "contains_callcode",
+    "contains_staticcall",
+    "all_require_vars",
+    "payable",
+    "is_constructor",
+    "has_modifiers",
+    "has_owner_modifier",
+    "transfers_value",
+)
+
+_CALL_KIND = {
+    "call": "contains_call",
+    "delegatecall": "contains_delegatecall",
+    "callcode": "contains_callcode",
+    "staticcall": "contains_staticcall",
+}
+
+_OWNER_HINTS = ("owner", "admin", "auth")
+
+
+def _walk(node, visit) -> None:
+    if isinstance(node, dict):
+        visit(node)
+        for value in node.values():
+            _walk(value, visit)
+    elif isinstance(node, list):
+        for item in node:
+            _walk(item, visit)
+
+
+class SolidityFeatureExtractor:
+    def __init__(self, ast: dict):
+        self.ast = ast or {}
+
+    def extract_features(self) -> Dict[str, Dict]:
+        """function name -> feature dict."""
+        out: Dict[str, Dict] = {}
+        for fn in self._function_nodes():
+            out[fn.get("name") or "constructor"] = self._features_of(fn)
+        return out
+
+    def _function_nodes(self) -> List[dict]:
+        nodes = []
+
+        def visit(node):
+            if node.get("nodeType") == "FunctionDefinition":
+                nodes.append(node)
+
+        _walk(self.ast, visit)
+        return nodes
+
+    def _features_of(self, fn: dict) -> Dict:
+        features = {name: False for name in FEATURES}
+        features["all_require_vars"] = set()
+        features["is_constructor"] = fn.get("kind") == "constructor"
+        features["payable"] = fn.get("stateMutability") == "payable"
+        modifiers = fn.get("modifiers") or []
+        features["has_modifiers"] = bool(modifiers)
+        features["has_owner_modifier"] = any(
+            hint in (m.get("modifierName", {}).get("name", "").lower())
+            for m in modifiers for hint in _OWNER_HINTS
+        )
+
+        def visit(node):
+            node_type = node.get("nodeType")
+            if node_type == "FunctionCall":
+                callee = node.get("expression", {})
+                name = callee.get("name")
+                member = callee.get("memberName")
+                if name == "selfdestruct" or name == "suicide":
+                    features["contains_selfdestruct"] = True
+                if member in _CALL_KIND:
+                    features[_CALL_KIND[member]] = True
+                if member in ("transfer", "send"):
+                    features["transfers_value"] = True
+                if name in ("require", "assert"):
+                    for arg in node.get("arguments", []):
+                        _walk(arg, lambda n: (
+                            features["all_require_vars"].add(n["name"])
+                            if n.get("nodeType") == "Identifier" else None
+                        ))
+
+        _walk(fn.get("body") or {}, visit)
+        return features

@@ -1,0 +1,209 @@
+"""SolidityContract — compile .sol files with solc and carry srcmaps
+(reference mythril/solidity/soliditycontract.py:395; solc invocation as in
+mythril/ethereum/util.py get_solc_json).
+
+The solc binary itself is an external tool (SURVEY §2.9: out of scope to
+rebuild); it is located via $SOLC or PATH and its standard-json output is
+parsed here. Everything downstream (srcmap decoding, instruction-offset ->
+source-line resolution for reports) is implemented locally.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+from typing import Dict, List, Optional
+
+from mythril_tpu.ethereum.evmcontract import EVMContract
+
+
+class SolcError(Exception):
+    pass
+
+
+class NoContractFoundError(Exception):
+    pass
+
+
+def find_solc(solc_binary: Optional[str] = None) -> str:
+    binary = solc_binary or os.environ.get("SOLC") or shutil.which("solc")
+    if not binary or not (os.path.exists(binary) or shutil.which(binary)):
+        raise ImportError(
+            "solc binary not found (install solc or set $SOLC)"
+        )
+    return binary
+
+
+def get_solc_json(file_path: str, solc_binary: Optional[str] = None,
+                  solc_args: Optional[List[str]] = None) -> dict:
+    """Run `solc --standard-json` on one file; returns the parsed output."""
+    binary = find_solc(solc_binary)
+    with open(file_path) as handle:
+        source = handle.read()
+    standard_input = {
+        "language": "Solidity",
+        "sources": {file_path: {"content": source}},
+        "settings": {
+            "outputSelection": {
+                "*": {
+                    "*": [
+                        "evm.bytecode.object",
+                        "evm.bytecode.sourceMap",
+                        "evm.deployedBytecode.object",
+                        "evm.deployedBytecode.sourceMap",
+                        "abi",
+                    ],
+                    "": ["ast"],
+                }
+            },
+            "optimizer": {"enabled": False},
+        },
+    }
+    proc = subprocess.run(
+        [binary, "--standard-json", "--allow-paths", "."]
+        + (solc_args or []),
+        input=json.dumps(standard_input),
+        capture_output=True, text=True,
+    )
+    if proc.returncode:
+        raise SolcError(f"solc failed: {proc.stderr[:500]}")
+    output = json.loads(proc.stdout)
+    errors = [e for e in output.get("errors", [])
+              if e.get("severity") == "error"]
+    if errors:
+        raise SolcError(errors[0].get("formattedMessage",
+                                      errors[0].get("message", "solc error")))
+    return output
+
+
+class SourceMapping:
+    """One decoded solc srcmap entry: s:l:f[:j[:m]]."""
+
+    __slots__ = ("offset", "length", "file_index", "lineno", "solc_mapping")
+
+    def __init__(self, offset: int, length: int, file_index: int,
+                 lineno: Optional[int], solc_mapping: str):
+        self.offset = offset
+        self.length = length
+        self.file_index = file_index
+        self.lineno = lineno
+        self.solc_mapping = solc_mapping
+
+
+class SourceInfo:
+    __slots__ = ("filename", "code", "lineno", "solc_mapping")
+
+    def __init__(self, filename: str, code: str, lineno: Optional[int],
+                 solc_mapping: str):
+        self.filename = filename
+        self.code = code
+        self.lineno = lineno
+        self.solc_mapping = solc_mapping
+
+
+def decode_srcmap(srcmap: str) -> List[List[str]]:
+    """solc srcmap run-length decoding: empty fields inherit the previous
+    entry's value."""
+    entries = []
+    prev = ["0", "0", "0", "-", "0"]
+    for item in srcmap.split(";"):
+        fields = item.split(":")
+        entry = list(prev)
+        for i, field in enumerate(fields):
+            if field:
+                entry[i] = field
+        entries.append(entry)
+        prev = entry
+    return entries
+
+
+def _strip_placeholders(bytecode: str) -> str:
+    """Unlinked library placeholders (__$...$__) become zero addresses."""
+    out = []
+    i = 0
+    while i < len(bytecode):
+        if bytecode.startswith("__", i):
+            end = bytecode.find("__", i + 2)
+            span = (end + 2 - i) if end != -1 else 40
+            out.append("0" * span)
+            i += span
+        else:
+            out.append(bytecode[i])
+            i += 1
+    return "".join(out)
+
+
+class SolidityContract(EVMContract):
+    def __init__(self, input_file: str, name: str, solc_output: dict):
+        contracts = solc_output["contracts"][input_file]
+        data = contracts[name]
+        evm = data["evm"]
+        super().__init__(
+            code=_strip_placeholders(evm["deployedBytecode"]["object"]),
+            creation_code=_strip_placeholders(evm["bytecode"]["object"]),
+            name=name,
+        )
+        self.input_file = input_file
+        self.solc_indices = self._build_source_index(solc_output)
+        self.srcmap = decode_srcmap(
+            evm["deployedBytecode"].get("sourceMap", ""))
+        self.creation_srcmap = decode_srcmap(
+            evm["bytecode"].get("sourceMap", ""))
+        self.abi = data.get("abi", [])
+        with open(input_file) as handle:
+            self.source_text = handle.read()
+
+    @staticmethod
+    def _build_source_index(solc_output: dict) -> Dict[int, str]:
+        indices = {}
+        for path, meta in solc_output.get("sources", {}).items():
+            indices[meta.get("id", 0)] = path
+        return indices
+
+    def _mapping_at(self, address: int, constructor: bool):
+        disassembly = (self.creation_disassembly if constructor
+                       else self.disassembly)
+        srcmap = self.creation_srcmap if constructor else self.srcmap
+        index = disassembly.index_of_address(address)
+        if index is None or index >= len(srcmap):
+            return None
+        return srcmap[index]
+
+    def get_source_info(self, address: int,
+                        constructor: bool = False) -> Optional[SourceInfo]:
+        entry = self._mapping_at(address, constructor)
+        if entry is None:
+            return None
+        offset, length, file_index = (int(entry[0]), int(entry[1]),
+                                      int(entry[2]))
+        if file_index < 0:  # autogenerated code (no source)
+            return None
+        filename = self.solc_indices.get(file_index, self.input_file)
+        snippet = self.source_text[offset: offset + length]
+        lineno = self.source_text[:offset].count("\n") + 1
+        return SourceInfo(
+            filename=filename,
+            code=snippet,
+            lineno=lineno,
+            solc_mapping=f"{offset}:{length}:{file_index}",
+        )
+
+
+def get_contracts_from_file(
+    input_file: str,
+    solc_binary: Optional[str] = None,
+    solc_args: Optional[List[str]] = None,
+) -> List[SolidityContract]:
+    """All deployable contracts in a file, file-order, skipping interfaces
+    (empty bytecode)."""
+    output = get_solc_json(input_file, solc_binary, solc_args)
+    contracts = []
+    for name, data in output.get("contracts", {}).get(input_file, {}).items():
+        if not data.get("evm", {}).get("deployedBytecode", {}).get("object"):
+            continue
+        contracts.append(SolidityContract(input_file, name, output))
+    if not contracts:
+        raise NoContractFoundError(
+            f"no deployable contract found in {input_file}"
+        )
+    return contracts

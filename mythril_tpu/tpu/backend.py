@@ -141,7 +141,8 @@ class DeviceSolverBackend:
                 break
             if time.monotonic() >= deadline:
                 break
-            # periodic restart: re-randomize the worst half of the batch
+            # periodic restart: re-randomize a fixed half of the batch to
+            # escape stagnation (cheap diversification; no per-row scoring)
             if rounds % 8 == 0:
                 key, re_key = jax.random.split(key)
                 fresh = walksat.init_assignments(

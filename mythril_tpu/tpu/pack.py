@@ -36,10 +36,14 @@ def dense_caps() -> Tuple[int, int]:
 
 
 def _bucket(n: int, floor: int, cap: int) -> int:
+    """Next power-of-two bucket >= n. The cap is enforced by callers via
+    fits_dense() BEFORE packing (a problem must never be truncated); cap
+    is accepted here only to keep the call sites self-documenting."""
+    del cap
     size = floor
     while size < n:
         size *= 2
-    return min(size, cap) if size <= cap else size
+    return size
 
 
 class PackedCNF:

@@ -1,0 +1,125 @@
+"""Input-layer tests: solidity frontend (srcmap decoding, feature
+extraction — solc-dependent parts are gated), RPC client (mocked at the
+_call boundary, reference tests/rpc_test.py pattern), DynLoader."""
+
+import shutil
+
+import pytest
+
+from mythril_tpu.ethereum.interface.client import EthJsonRpc, RpcError
+from mythril_tpu.solidity.features import SolidityFeatureExtractor
+from mythril_tpu.solidity.soliditycontract import (
+    _strip_placeholders,
+    decode_srcmap,
+)
+from mythril_tpu.support.loader import DynLoader
+
+
+def test_srcmap_decoding_inherits_empty_fields():
+    entries = decode_srcmap("0:100:0:-;;10:5;:8:1")
+    assert entries[0][:3] == ["0", "100", "0"]
+    assert entries[1][:3] == ["0", "100", "0"]       # fully inherited
+    assert entries[2][:3] == ["10", "5", "0"]        # offset+len updated
+    assert entries[3][:3] == ["10", "8", "1"]        # len+file updated
+
+
+def test_library_placeholders_stripped():
+    code = "6060__$abc123$__6001"
+    stripped = _strip_placeholders(code)
+    assert len(stripped) == len(code)
+    assert "__" not in stripped
+    bytes.fromhex(stripped)  # must be valid hex now
+
+
+def test_feature_extractor_finds_selfdestruct_and_calls():
+    ast = {
+        "nodeType": "SourceUnit",
+        "nodes": [{
+            "nodeType": "FunctionDefinition",
+            "name": "kill",
+            "stateMutability": "nonpayable",
+            "modifiers": [{"modifierName": {"name": "onlyOwner"}}],
+            "body": {
+                "nodeType": "Block",
+                "statements": [{
+                    "nodeType": "FunctionCall",
+                    "expression": {"name": "selfdestruct"},
+                    "arguments": [],
+                }, {
+                    "nodeType": "FunctionCall",
+                    "expression": {"name": "require"},
+                    "arguments": [{"nodeType": "Identifier",
+                                   "name": "unlocked"}],
+                }],
+            },
+        }],
+    }
+    features = SolidityFeatureExtractor(ast).extract_features()
+    assert features["kill"]["contains_selfdestruct"]
+    assert features["kill"]["has_owner_modifier"]
+    assert "unlocked" in features["kill"]["all_require_vars"]
+
+
+@pytest.mark.skipif(shutil.which("solc") is None, reason="solc not installed")
+def test_solidity_contract_compiles(tmp_path):
+    source = tmp_path / "simple.sol"
+    source.write_text(
+        "pragma solidity ^0.8.0;\n"
+        "contract Simple { function f() public pure returns (uint) "
+        "{ return 1; } }\n"
+    )
+    from mythril_tpu.solidity.soliditycontract import get_contracts_from_file
+
+    contracts = get_contracts_from_file(str(source))
+    assert contracts and contracts[0].name == "Simple"
+    assert contracts[0].code_bytes
+
+
+class _MockRpc(EthJsonRpc):
+    def __init__(self, responses):
+        super().__init__("mock", 1)
+        self.responses = responses
+        self.calls = []
+
+    def _call(self, method, params):
+        self.calls.append((method, params))
+        return self.responses[method]
+
+
+def test_rpc_client_methods_and_url():
+    rpc = _MockRpc({
+        "eth_getCode": "0x6001",
+        "eth_getStorageAt": "0x" + "00" * 31 + "2a",
+        "eth_getBalance": "0x10",
+    })
+    assert rpc.eth_getCode("0xabc") == "0x6001"
+    assert int(rpc.eth_getStorageAt("0xabc", 1), 16) == 42
+    assert rpc.eth_getBalance("0xabc") == 16
+    assert rpc.calls[1][1][1] == "0x1"  # int position hex-encoded
+
+    assert EthJsonRpc("h", 1, tls=True).url == "https://h:1"
+    infura = EthJsonRpc.from_cli("infura-mainnet", infura_id="k")
+    assert infura.url == "https://mainnet.infura.io/v3/k"
+    with pytest.raises(RpcError):
+        EthJsonRpc.from_cli("infura-nonet")
+    plain = EthJsonRpc.from_cli("myhost:7777")
+    assert plain.url == "http://myhost:7777"
+
+
+def test_dynloader_caches_and_disassembles():
+    rpc = _MockRpc({
+        "eth_getCode": "0x60016002",
+        "eth_getStorageAt": "0x5",
+        "eth_getBalance": "0x10",
+    })
+    loader = DynLoader(rpc)
+    code1 = loader.dynld("0x" + "11" * 20)
+    code2 = loader.dynld("0x" + "11" * 20)
+    assert code1 is code2  # lru cached: one RPC round trip
+    assert len([c for c in rpc.calls if c[0] == "eth_getCode"]) == 1
+    assert [i.opcode for i in code1.instruction_list][:2] == ["PUSH1", "PUSH1"]
+    assert loader.read_storage("0xabc", 0) == "0x5"
+    assert loader.read_balance("0xabc") == 16
+
+    inactive = DynLoader(rpc, active=False)
+    assert inactive.dynld("0x" + "22" * 20) is None
