@@ -36,6 +36,13 @@ class DetectionModule:
     def reset_module(self):
         self.issues = []
 
+    def reset_cache(self):
+        """Clear the (address, bytecode-hash) dedupe cache. Called at the
+        start of each analysis session (core.fire_lasers) so repeated
+        library-level analyses of the same bytecode re-detect issues; the
+        reference never needs this because each CLI run is one process."""
+        self.cache = set()
+
     def update_cache(self, issues=None):
         issues = issues if issues is not None else self.issues
         for issue in issues:

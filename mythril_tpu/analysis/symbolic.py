@@ -51,11 +51,18 @@ class SymExecWrapper:
         elif isinstance(address, int):
             address = symbol_factory.BitVecVal(address, 256)
 
+        from mythril_tpu.laser.strategy.beam import BeamSearch
+        from mythril_tpu.laser.strategy.constraint_strategy import (
+            DelayConstraintStrategy,
+        )
+
         strategies = {
             "dfs": DepthFirstSearchStrategy,
             "bfs": BreadthFirstSearchStrategy,
             "naive-random": ReturnRandomNaivelyStrategy,
             "weighted-random": ReturnWeightedRandomStrategy,
+            "beam-search": BeamSearch,
+            "pending": DelayConstraintStrategy,
         }
         try:
             strategy_class = strategies[strategy]
@@ -78,8 +85,17 @@ class SymExecWrapper:
             strategy=strategy_class,
             transaction_count=transaction_count,
             requires_statespace=requires_statespace,
+            beam_width=(getattr(args, "beam_width", None)
+                        if strategy == "beam-search" else None),
         )
         self.laser.extend_strategy(BoundedLoopsStrategy, loop_bound=loop_bound)
+
+        if not args.incremental_txs:
+            from mythril_tpu.laser.tx_prioritiser import RfTxPrioritiser
+
+            self.laser.tx_prioritiser = RfTxPrioritiser(
+                contract, model_path=getattr(args, "rf_model_path", None)
+            )
 
         # engine plugins (pruners/coverage/etc.) are registered here
         from mythril_tpu.laser.plugin.loader import LaserPluginLoader
@@ -99,7 +115,24 @@ class SymExecWrapper:
             plugin_loader.load(DependencyPrunerBuilder())
         if not args.disable_iprof:
             plugin_loader.load(InstructionProfilerBuilder())
+        if args.enable_state_merging:
+            from mythril_tpu.laser.plugin.plugins import (
+                StateMergePluginBuilder,
+            )
+
+            plugin_loader.load(StateMergePluginBuilder())
         plugin_loader.instrument_virtual_machine(self.laser)
+
+        if not args.disable_coverage_strategy:
+            from mythril_tpu.laser.plugin.plugins.coverage import (
+                CoverageStrategy,
+            )
+
+            coverage_plugin = plugin_loader.plugin_list.get("coverage")
+            if coverage_plugin is not None:
+                self.laser.extend_strategy(
+                    CoverageStrategy, coverage_plugin=coverage_plugin
+                )
 
         if run_analysis_modules:
             analysis_modules = ModuleLoader().get_detection_modules(

@@ -62,6 +62,18 @@ def concrete_execution(
     tracer.initialize(laser_evm)
     time_handler.start_execution(laser_evm.execution_timeout)
     for transaction in concrete_data["steps"]:
+        if transaction["address"] == "":
+            # creation step (same shape runner.flip_branches handles)
+            from mythril_tpu.laser.transaction.symbolic import (
+                execute_contract_creation,
+            )
+
+            for world_state in laser_evm.open_states[:]:
+                execute_contract_creation(
+                    laser_evm, transaction["input"][2:],
+                    world_state=world_state,
+                )
+            continue
         execute_transaction(
             laser_evm,
             callee_address=_to_int(transaction["address"]),

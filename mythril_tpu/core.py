@@ -67,6 +67,7 @@ class MythrilAnalyzer:
     ):
         self.contracts = disassembler.contracts
         self.strategy = strategy
+        self.eth = disassembler.eth
         self.address = address if address is not None else ANALYSIS_ADDRESS
         # copy CLI args into the global singleton (reference :65-76)
         if cmd_args is not None:
@@ -77,16 +78,24 @@ class MythrilAnalyzer:
                 "unconstrained_storage", "parallel_solving", "disable_iprof",
                 "disable_mutation_pruner", "disable_dependency_pruning",
                 "enable_state_merging", "enable_summaries", "solver_backend",
-                "transaction_sequences",
+                "transaction_sequences", "beam_width",
+                "disable_coverage_strategy",
             ):
                 if hasattr(cmd_args, field) and getattr(cmd_args, field) is not None:
                     setattr(args, field, getattr(cmd_args, field))
+            if getattr(cmd_args, "disable_incremental_txs", False):
+                args.incremental_txs = False
         # auto pruning factor (reference :78-82)
         if args.pruning_factor is None:
             args.pruning_factor = 1.0 if args.execution_timeout > 300 else 0.0
 
     def fire_lasers(self, modules: Optional[List[str]] = None,
                     transaction_count: Optional[int] = None) -> Report:
+        from mythril_tpu.analysis.module import ModuleLoader
+
+        for module in ModuleLoader().get_detection_modules():
+            module.reset_module()
+            module.reset_cache()
         stats = SolverStatistics()
         stats.enabled = True
         all_issues: List[Issue] = []
@@ -99,11 +108,17 @@ class MythrilAnalyzer:
             )
 
             keccak_function_manager.reset()
+            dynloader = None
+            if self.eth is not None:
+                from mythril_tpu.support.loader import DynLoader
+
+                dynloader = DynLoader(self.eth)
             try:
                 sym = SymExecWrapper(
                     contract,
                     self.address,
                     self.strategy,
+                    dynloader=dynloader,
                     max_depth=args.max_depth,
                     execution_timeout=args.execution_timeout,
                     loop_bound=args.loop_bound,

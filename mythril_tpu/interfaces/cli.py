@@ -103,7 +103,10 @@ def add_analysis_args(parser) -> None:
     parser.add_argument("--max-depth", type=int, default=128)
     parser.add_argument("--strategy", default="bfs",
                         choices=["dfs", "bfs", "naive-random",
-                                 "weighted-random"])
+                                 "weighted-random", "beam-search", "pending"])
+    parser.add_argument("--beam-search", type=int, metavar="WIDTH",
+                        dest="beam_width", default=None,
+                        help="shortcut: --strategy beam-search with WIDTH")
     parser.add_argument("--execution-timeout", type=int, default=86400)
     parser.add_argument("--create-timeout", type=int, default=10)
     parser.add_argument("--solver-timeout", type=int, default=25000)
@@ -117,12 +120,17 @@ def add_analysis_args(parser) -> None:
                         choices=["cpu", "tpu"],
                         help="satisfiability backend (tpu = batched device solver)")
     parser.add_argument("--disable-mutation-pruner", action="store_true")
+    parser.add_argument("--disable-coverage-strategy", action="store_true")
     parser.add_argument("--disable-dependency-pruning", action="store_true")
     parser.add_argument("--disable-iprof", action="store_true")
     parser.add_argument("--enable-state-merging", action="store_true")
     parser.add_argument("--enable-summaries", action="store_true")
     parser.add_argument("--transaction-sequences",
                         help="pinned function sequences, e.g. [[0xa9059cbb],[-1]]")
+    parser.add_argument("--disable-incremental-txs", action="store_true",
+                        dest="disable_incremental_txs",
+                        help="explore prioritizer-ranked function sequences "
+                             "instead of incremental tx ordering")
 
 
 def add_output_args(parser) -> None:
@@ -245,10 +253,13 @@ def execute_command(parsed) -> int:
         address = None
         if getattr(parsed, "address", None):
             address = int(parsed.address, 16)
+        strategy = parsed.strategy
+        if getattr(parsed, "beam_width", None):
+            strategy = "beam-search"
         analyzer = MythrilAnalyzer(
             disassembler,
             cmd_args=parsed,
-            strategy=parsed.strategy,
+            strategy=strategy,
             address=address,
         )
         modules = parsed.modules.split(",") if parsed.modules else None

@@ -16,11 +16,13 @@ class LaserPluginLoader:
             cls._instance = super().__new__(cls)
             cls._instance.laser_plugin_builders = {}
             cls._instance.plugin_args = {}
+            cls._instance.plugin_list = {}
         return cls._instance
 
     def reset(self):
         self.laser_plugin_builders = {}
         self.plugin_args = {}
+        self.plugin_list = {}
 
     def load(self, builder: PluginBuilder) -> None:
         if builder.name in self.laser_plugin_builders:
@@ -51,3 +53,4 @@ class LaserPluginLoader:
                 continue
             plugin = builder(**self.plugin_args.get(name, {}))
             plugin.initialize(symbolic_vm)
+            self.plugin_list[name] = plugin

@@ -13,3 +13,9 @@ from mythril_tpu.laser.plugin.plugins.call_depth_limiter import (  # noqa: F401
 from mythril_tpu.laser.plugin.plugins.dependency_pruner import (  # noqa: F401
     DependencyPrunerBuilder,
 )
+from mythril_tpu.laser.plugin.plugins.state_merge import (  # noqa: F401
+    StateMergePluginBuilder,
+)
+from mythril_tpu.laser.plugin.plugins.trace import (  # noqa: F401
+    TraceFinderBuilder,
+)

@@ -70,6 +70,40 @@ class InstructionCoveragePlugin(LaserPlugin):
         return sum(sum(seen) for _total, seen in self.coverage.values())
 
 
+class CoverageStrategy:
+    """Strategy wrapper preferring states whose pc is not yet covered
+    (reference plugin/plugins/coverage/coverage_strategy.py:6)."""
+
+    def __init__(self, super_strategy, coverage_plugin:
+                 InstructionCoveragePlugin):
+        self.super_strategy = super_strategy
+        self.coverage_plugin = coverage_plugin
+        self.work_list = super_strategy.work_list
+        self.max_depth = super_strategy.max_depth
+
+    def __iter__(self):
+        return self
+
+    def run_check(self):
+        return self.super_strategy.run_check()
+
+    def _is_covered(self, state) -> bool:
+        code = state.environment.code
+        entry = self.coverage_plugin.coverage.get(code.bytecode_hash)
+        if entry is None:
+            return False
+        index = code.index_of_address(state.mstate.pc)
+        return index is not None and entry[1][index]
+
+    def __next__(self):
+        for i, state in enumerate(self.work_list):
+            if not self._is_covered(state):
+                if state.mstate.depth < self.max_depth:
+                    del self.work_list[i]
+                    return state
+        return next(self.super_strategy)
+
+
 class CoveragePluginBuilder(PluginBuilder):
     name = "coverage"
 

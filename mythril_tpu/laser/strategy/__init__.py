@@ -25,10 +25,15 @@ class BasicSearchStrategy:
         return True
 
     def __next__(self) -> GlobalState:
+        # exhaustion is signalled by get_strategic_global_state (empty pop
+        # raises IndexError), NOT by checking work_list here — strategies
+        # like DelayConstraintStrategy refill the worklist from a pending
+        # pool exactly when it runs dry
         while True:
-            if not self.work_list:
+            try:
+                state = self.get_strategic_global_state()
+            except IndexError:
                 raise StopIteration
-            state = self.get_strategic_global_state()
             if state.mstate.depth < self.max_depth:
                 return state
             # depth-capped states are dropped (their world state was already

@@ -17,6 +17,8 @@ class BreadthFirstSearchStrategy(BasicSearchStrategy):
 
 class ReturnRandomNaivelyStrategy(BasicSearchStrategy):
     def get_strategic_global_state(self):
+        if not self.work_list:
+            raise IndexError  # exhausted (see BasicSearchStrategy.__next__)
         index = random.randrange(len(self.work_list))
         return self.work_list.pop(index)
 

@@ -1,0 +1,46 @@
+"""DelayConstraintStrategy — "pending" scheduling
+(reference laser/ethereum/strategy/constraint_strategy.py:10).
+
+Skips per-fork satisfiability checks during exploration: states whose
+reachability was not yet proven are parked in `pending_worklist`; when the
+ready worklist drains, pending states are solved (models feeding the
+global quick-sat cache) and revived if reachable. Trades solver latency
+off the hot path for batched/delayed checks — on the device backend the
+drained pending batch is exactly the sibling-path bundle the batched
+solver wants.
+"""
+
+import logging
+
+from mythril_tpu.laser.strategy import BasicSearchStrategy
+from mythril_tpu.smt.solver.frontend import SolverTimeOutException, UnsatError
+from mythril_tpu.support.model import get_model, model_cache
+
+log = logging.getLogger(__name__)
+
+
+class DelayConstraintStrategy(BasicSearchStrategy):
+    def __init__(self, work_list, max_depth, **kwargs):
+        super().__init__(work_list, max_depth, **kwargs)
+        self.pending_worklist = []
+
+    def run_check(self) -> bool:
+        """Forks are accepted unchecked; reachability is decided lazily."""
+        return False
+
+    def get_strategic_global_state(self):
+        while not self.work_list:
+            if not self.pending_worklist:
+                raise StopIteration
+            state = self.pending_worklist.pop(0)
+            try:
+                model = get_model(
+                    state.world_state.constraints.get_all_constraints())
+            except UnsatError:
+                continue
+            except SolverTimeOutException:
+                model = None  # unknown counts as possible: cannot prune
+            if model is not None:
+                model_cache.put(model)
+            self.work_list.append(state)
+        return self.work_list.pop(0)

@@ -100,6 +100,8 @@ class LaserEVM:
 
         self.time: Optional[float] = None
         self._start_time: Optional[float] = None
+        # optional selector-ranking provider (laser/tx_prioritiser.py)
+        self.tx_prioritiser = None
 
     # -- hook registration ---------------------------------------------------
 
@@ -180,17 +182,41 @@ class LaserEVM:
         from mythril_tpu.laser.transaction.symbolic import execute_message_call
 
         pinned_sequences = self._parse_transaction_sequences()
+        if pinned_sequences is None and getattr(self, "tx_prioritiser", None):
+            # non-ordered exploration: the prioritizer pins the selector
+            # order per tx (reference svm.py:241-250 via rf_prioritiser)
+            pinned_sequences = self.tx_prioritiser.predict_sequences(
+                self.transaction_count)
         self._fire("start_execute_transactions")
         self.executed_transactions = True
         for i in range(self.transaction_count):
             if len(self.open_states) == 0:
                 break
-            # reachability prune of open states (reference :266-286)
+            # reachability prune of open states (reference :266-286); the
+            # pending strategy probes the model cache before full solves
+            # (reference constraint_strategy.py "delayed solving")
             if self.use_reachability_check and i > 0:
+                from mythril_tpu.laser.strategy.constraint_strategy import (
+                    DelayConstraintStrategy,
+                )
+                from mythril_tpu.support.model import model_cache
+
                 before = len(self.open_states)
-                self.open_states = [
-                    ws for ws in self.open_states if ws.constraints.is_possible
-                ]
+                base = self.strategy
+                while hasattr(base, "super_strategy"):
+                    base = base.super_strategy
+                if isinstance(base, DelayConstraintStrategy):
+                    self.open_states = [
+                        ws for ws in self.open_states
+                        if model_cache.check_quick_sat(
+                            ws.constraints.get_all_constraints()
+                        ) is not None or ws.constraints.is_possible
+                    ]
+                else:
+                    self.open_states = [
+                        ws for ws in self.open_states
+                        if ws.constraints.is_possible
+                    ]
                 log.info(
                     "tx %d: %d/%d open states reachable",
                     i + 1, len(self.open_states), before,
@@ -274,6 +300,28 @@ class LaserEVM:
                         for s in new_states
                         if s.world_state.constraints.is_possible
                     ]
+                elif not self.strategy.run_check():
+                    # delayed-solving strategy: forks failing the quick
+                    # model-cache probe are parked in pending_worklist and
+                    # batch-solved when the ready worklist drains
+                    # (strategy/constraint_strategy.py)
+                    base = self.strategy
+                    while hasattr(base, "super_strategy"):
+                        base = base.super_strategy
+                    pending = getattr(base, "pending_worklist", None)
+                    if pending is not None:
+                        from mythril_tpu.support.model import model_cache
+
+                        ready = []
+                        for state in new_states:
+                            if model_cache.check_quick_sat(
+                                state.world_state.constraints
+                                .get_all_constraints()
+                            ) is not None:
+                                ready.append(state)
+                            else:
+                                pending.append(state)
+                        new_states = ready
             self.manage_cfg(op_code, new_states)
             self.work_list.extend(new_states)
             self.total_states += len(new_states)

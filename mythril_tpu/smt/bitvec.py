@@ -259,11 +259,21 @@ def SignExt(extra: int, value: BitVec) -> BitVec:
 
 
 def If(cond, then, otherwise):
-    """Polymorphic ite over BitVec/Bool wrappers (mixed ints coerced)."""
+    """Polymorphic ite over BitVec/Bool/Array wrappers (ints coerced)."""
     from mythril_tpu.smt.bool_expr import Bool
 
     if isinstance(cond, bool):
         cond = Bool.value(cond)
+    from mythril_tpu.smt.array_expr import BaseArray
+
+    if isinstance(then, BaseArray):
+        # array-sorted ite (state merging): rebuild as a BaseArray wrapper
+        merged = BaseArray.__new__(type(then))
+        merged.raw = terms.ite(cond.raw, then.raw, otherwise.raw)
+        merged.annotations = _union(
+            cond.annotations, then.annotations, otherwise.annotations
+        )
+        return merged
     if isinstance(then, BitVec) or isinstance(otherwise, BitVec):
         width = then.size if isinstance(then, BitVec) else otherwise.size
         then = coerce(then, width)

@@ -23,6 +23,7 @@ SAT, UNSAT, UNKNOWN = "sat", "unsat", "unknown"
 _lib = None
 _lib_lock = threading.Lock()
 _native_failed = False
+_device_warned = False
 
 
 def _compile_native() -> Optional[ctypes.CDLL]:
@@ -114,10 +115,17 @@ def solve_cnf(
                 num_vars, clauses, assumptions, budget_seconds=device_budget)
             if bits is not None:
                 return SAT, bits
-        except Exception:
+        except Exception as error:
             # jax absent OR broken at runtime (device OOM, compile error,
             # wedged transport): degrade to CDCL-only, never crash the run
-            pass
+            global _device_warned
+            if not _device_warned:
+                _device_warned = True
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "device solver unavailable, falling back to CDCL "
+                    "for the rest of the run: %s", error)
         if timeout_seconds:
             timeout_seconds = max(
                 0.05, timeout_seconds - (_time.monotonic() - start))

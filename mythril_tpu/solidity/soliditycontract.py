@@ -76,20 +76,6 @@ def get_solc_json(file_path: str, solc_binary: Optional[str] = None,
     return output
 
 
-class SourceMapping:
-    """One decoded solc srcmap entry: s:l:f[:j[:m]]."""
-
-    __slots__ = ("offset", "length", "file_index", "lineno", "solc_mapping")
-
-    def __init__(self, offset: int, length: int, file_index: int,
-                 lineno: Optional[int], solc_mapping: str):
-        self.offset = offset
-        self.length = length
-        self.file_index = file_index
-        self.lineno = lineno
-        self.solc_mapping = solc_mapping
-
-
 class SourceInfo:
     __slots__ = ("filename", "code", "lineno", "solc_mapping")
 
@@ -150,6 +136,8 @@ class SolidityContract(EVMContract):
         self.creation_srcmap = decode_srcmap(
             evm["bytecode"].get("sourceMap", ""))
         self.abi = data.get("abi", [])
+        self.solc_ast = solc_output.get("sources", {}).get(
+            input_file, {}).get("ast")  # feeds laser/tx_prioritiser.py
         with open(input_file) as handle:
             self.source_text = handle.read()
 

@@ -1,0 +1,201 @@
+"""Strategy and plugin tests: beam search honors search_importance
+(reference tests/laser/strategy/beam_test.py pattern), delayed-constraint
+scheduling, state merging, benchmark/coverage-metrics outputs, tx
+prioritizer ranking."""
+
+import json
+
+from mythril_tpu.disasm.asm import easm_to_code
+from mythril_tpu.core import MythrilAnalyzer, MythrilDisassembler
+from mythril_tpu.laser.state.annotation import StateAnnotation
+from mythril_tpu.laser.strategy.beam import BeamSearch
+from mythril_tpu.support.args import args
+
+
+class _Weight(StateAnnotation):
+    def __init__(self, weight):
+        self.weight = weight
+
+    @property
+    def search_importance(self):
+        return self.weight
+
+
+class _FakeState:
+    def __init__(self, weight):
+        self.annotations = [_Weight(weight)]
+
+        class _M:
+            depth = 0
+        self.mstate = _M()
+
+
+def test_beam_search_keeps_highest_importance():
+    states = [_FakeState(w) for w in (1, 9, 5, 7, 3)]
+    beam = BeamSearch(states, max_depth=128, beam_width=2)
+    first = beam.get_strategic_global_state()
+    assert first.annotations[0].weight == 9
+    assert len(beam.work_list) == 1
+    assert beam.work_list[0].annotations[0].weight == 7
+
+
+def _analyze(code_hex, tx_count=2, **arg_overrides):
+    class _Args:
+        execution_timeout = 60
+        transaction_count = tx_count
+        max_depth = 128
+
+    strategy = arg_overrides.pop("strategy", "bfs")
+    saved = {}
+    for key, value in arg_overrides.items():
+        saved[key] = getattr(args, key)
+        setattr(args, key, value)
+    try:
+        disassembler = MythrilDisassembler()
+        disassembler.load_from_bytecode(code_hex)
+        analyzer = MythrilAnalyzer(
+            disassembler, cmd_args=_Args(), strategy=strategy,
+        )
+        report = analyzer.fire_lasers(transaction_count=tx_count)
+        return report.sorted_issues()
+    finally:
+        for key, value in saved.items():
+            setattr(args, key, value)
+
+
+def wrap_creation(runtime: bytes) -> str:
+    init = easm_to_code(f"""
+        PUSH2 0x{len(runtime):04x}
+        PUSH1 0x0f
+        PUSH1 0x00
+        CODECOPY
+        PUSH2 0x{len(runtime):04x}
+        PUSH1 0x00
+        RETURN
+        STOP
+    """)
+    return (init + runtime).hex()
+
+
+KILLBILLY = easm_to_code("""
+    PUSH1 0x00
+    CALLDATALOAD
+    PUSH1 0xe0
+    SHR
+    DUP1
+    PUSH4 0x41c0e1b5
+    EQ
+    PUSH1 @kill
+    JUMPI
+    STOP
+:kill
+    JUMPDEST
+    CALLER
+    SELFDESTRUCT
+""")
+
+
+def test_pending_strategy_finds_same_issue():
+    issues = _analyze(wrap_creation(KILLBILLY), tx_count=1,
+                      strategy="pending")
+    assert "106" in {i.swc_id for i in issues}
+
+
+def test_beam_strategy_finds_same_issue():
+    issues = _analyze(wrap_creation(KILLBILLY), tx_count=1,
+                      strategy="beam-search")
+    assert "106" in {i.swc_id for i in issues}
+
+
+def test_state_merging_preserves_findings():
+    issues = _analyze(wrap_creation(KILLBILLY), tx_count=2,
+                      enable_state_merging=True)
+    assert "106" in {i.swc_id for i in issues}
+
+
+def test_state_merge_reduces_open_states():
+    """Two branch outcomes with identical post-states merge to one."""
+    from mythril_tpu.laser.plugin.plugins.state_merge import (
+        check_ws_merge_condition, merge_states,
+    )
+    from mythril_tpu.laser.state.world_state import WorldState
+    from mythril_tpu.smt import symbol_factory
+
+    x = symbol_factory.BitVecSym("x", 256)
+    ws1 = WorldState()
+    ws1.create_account(address=0x123, balance=0)
+    ws1.constraints.append(x > 5)
+    ws2 = ws1.clone()
+    ws2.constraints.pop()
+    ws2.constraints.append(x <= 5)
+    assert check_ws_merge_condition(ws1, ws2)
+    merge_states(ws1, ws2)
+    # Or(x>5, x<=5) is the only constraint: still satisfiable
+    assert ws1.constraints.is_possible
+
+
+def test_benchmark_and_coverage_metrics_plugins(tmp_path, monkeypatch):
+    from mythril_tpu.laser.plugin.plugins.benchmark import BenchmarkPlugin
+    from mythril_tpu.laser.plugin.plugins.coverage_metrics import (
+        CoverageMetricsPlugin,
+    )
+    from mythril_tpu.laser.svm import LaserEVM
+
+    monkeypatch.chdir(tmp_path)
+    laser = LaserEVM(transaction_count=1)
+    bench = BenchmarkPlugin(name="bench_out")
+    bench.initialize(laser)
+    metrics = CoverageMetricsPlugin(output_path="data.json")
+    metrics.initialize(laser)
+    laser.sym_exec(creation_code=wrap_creation(KILLBILLY),
+                   contract_name="T")
+
+    bench_data = json.loads((tmp_path / "bench_out.json").read_text())
+    assert bench_data["instructions_executed"] > 0
+    assert bench_data["coverage_over_time"]
+    metrics_data = json.loads((tmp_path / "data.json").read_text())
+    series = metrics_data["time_series"]
+    assert series and series[-1]["coverage"]
+    entries = list(series[-1]["coverage"].values())
+    # runtime code (one of the entries) gets well covered at tx_count=1
+    assert max(e["instruction_coverage"] for e in entries) > 0.5
+    assert sum(e["branches_covered"] for e in entries) >= 1
+
+
+def test_tx_prioritiser_ranks_selfdestruct_first():
+    from mythril_tpu.laser.tx_prioritiser import RfTxPrioritiser
+
+    class _Contract:
+        pass
+
+    class _Disassembly:
+        function_entries = {"41c0e1b5": 10, "a9059cbb": 20}
+
+    contract = _Contract()
+    contract.disassembly = _Disassembly()
+    contract.solc_ast = {
+        "nodeType": "SourceUnit",
+        "nodes": [
+            {
+                "nodeType": "FunctionDefinition",
+                "name": "kill",
+                "body": {"statements": [{
+                    "nodeType": "FunctionCall",
+                    "expression": {"name": "selfdestruct"},
+                }]},
+            },
+            {
+                "nodeType": "FunctionDefinition",
+                "name": "transfer",
+                "body": {"statements": []},
+            },
+        ],
+    }
+    prioritiser = RfTxPrioritiser(contract)
+    # map selector names: kill() == 41c0e1b5 per the builtin signature DB
+    sequences = prioritiser.predict_sequences(depth=3)
+    assert len(sequences) == 3
+    # tx 1 pinned to the selfdestruct-bearing function, ranked first
+    assert sequences[0] == [bytes.fromhex("41c0e1b5")]
+    # txs beyond the ranking fall back to the wildcard
+    assert sequences[2] == [-1]
