@@ -68,6 +68,27 @@ class DetectionModule:
         else:
             result = self._analyze_statespace(target)
         if result:
+            from mythril_tpu.support.args import args
+
+            if args.use_issue_annotations and \
+                    self.entry_point == EntryPoint.CALLBACK:
+                # summaries mode: direct results would be solved under
+                # parametric (summary-symbol) state — a false-positive
+                # source; carry them as annotations for substituted
+                # re-solving instead (reference base.py:94)
+                from mythril_tpu.analysis.issue_annotation import (
+                    IssueAnnotation,
+                )
+                from mythril_tpu.smt import And
+
+                for issue in result:
+                    target.annotate(IssueAnnotation(
+                        conditions=[And(
+                            *target.world_state.constraints)],
+                        issue=issue,
+                        detector=self,
+                    ))
+                return result
             self.issues.extend(result)
             self.update_cache(result)
         return result

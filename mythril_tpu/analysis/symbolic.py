@@ -121,6 +121,12 @@ class SymExecWrapper:
             )
 
             plugin_loader.load(StateMergePluginBuilder())
+        if args.enable_summaries:
+            from mythril_tpu.laser.plugin.plugins.summary import (
+                SymbolicSummaryPluginBuilder,
+            )
+
+            plugin_loader.load(SymbolicSummaryPluginBuilder())
         plugin_loader.instrument_virtual_machine(self.laser)
 
         if not args.disable_coverage_strategy:

@@ -1,0 +1,108 @@
+"""Symbolic summary plugin: recording, replay, and issue preservation
+(reference laser/plugin/plugins/summary/ behavior)."""
+
+from mythril_tpu.disasm.asm import easm_to_code
+from mythril_tpu.core import MythrilAnalyzer, MythrilDisassembler
+from mythril_tpu.support.args import args
+
+
+def wrap_creation(runtime: bytes) -> str:
+    init = easm_to_code(f"""
+        PUSH2 0x{len(runtime):04x}
+        PUSH1 0x0f
+        PUSH1 0x00
+        CODECOPY
+        PUSH2 0x{len(runtime):04x}
+        PUSH1 0x00
+        RETURN
+        STOP
+    """)
+    return (init + runtime).hex()
+
+
+KILLBILLY = easm_to_code("""
+    PUSH1 0x00
+    CALLDATALOAD
+    PUSH1 0xe0
+    SHR
+    DUP1
+    PUSH4 0x41c0e1b5
+    EQ
+    PUSH1 @kill
+    JUMPI
+    STOP
+:kill
+    JUMPDEST
+    CALLER
+    SELFDESTRUCT
+""")
+
+# store calldata word to slot 0: a mutating tx worth summarizing
+STORE_THEN_KILL = easm_to_code("""
+    PUSH1 0x00
+    CALLDATALOAD
+    PUSH1 0xe0
+    SHR
+    DUP1
+    PUSH4 0x11111111
+    EQ
+    PUSH1 @setter
+    JUMPI
+    DUP1
+    PUSH4 0x41c0e1b5
+    EQ
+    PUSH1 @kill
+    JUMPI
+    STOP
+:setter
+    JUMPDEST
+    PUSH1 0x04
+    CALLDATALOAD
+    PUSH1 0x00
+    SSTORE
+    STOP
+:kill
+    JUMPDEST
+    PUSH1 0x00
+    SLOAD
+    PUSH1 0x2a
+    EQ
+    PUSH1 @doit
+    JUMPI
+    STOP
+:doit
+    JUMPDEST
+    CALLER
+    SELFDESTRUCT
+""")
+
+
+def _analyze(code_hex, tx_count):
+    class _Args:
+        execution_timeout = 60
+        transaction_count = tx_count
+        max_depth = 128
+
+    args.enable_summaries = True
+    try:
+        disassembler = MythrilDisassembler()
+        disassembler.load_from_bytecode(code_hex)
+        analyzer = MythrilAnalyzer(disassembler, cmd_args=_Args(),
+                                   strategy="bfs")
+        report = analyzer.fire_lasers(transaction_count=tx_count)
+        return report.sorted_issues()
+    finally:
+        args.enable_summaries = False
+        args.use_issue_annotations = False
+
+
+def test_summaries_preserve_single_tx_finding():
+    issues = _analyze(wrap_creation(KILLBILLY), tx_count=1)
+    assert "106" in {i.swc_id for i in issues}
+
+
+def test_summaries_find_two_tx_exploit():
+    """tx1 must set slot0=42 (summarized), tx2 reaches SELFDESTRUCT."""
+    issues = _analyze(wrap_creation(STORE_THEN_KILL), tx_count=2)
+    swcs = {i.swc_id for i in issues}
+    assert "106" in swcs
