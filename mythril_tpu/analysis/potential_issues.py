@@ -51,9 +51,13 @@ class PotentialIssuesAnnotation(StateAnnotation):
         return 10 * len(self.potential_issues)
 
     def clone(self):
-        # shared across the path tree on purpose: potential issues found on
-        # one branch are checked when any descendant transaction ends
-        return self
+        # per-path copy: an issue detected on one branch must be confirmed
+        # with THAT branch's world state at its transaction end, so the
+        # concretized tx sequence matches the function the issue fired in
+        # (reference deep-copies annotations with the state)
+        dup = PotentialIssuesAnnotation()
+        dup.potential_issues = list(self.potential_issues)
+        return dup
 
 
 def get_potential_issues_annotation(global_state) -> PotentialIssuesAnnotation:
@@ -70,6 +74,20 @@ def check_potential_issues(global_state) -> None:
     annotation = get_potential_issues_annotation(global_state)
     unsatisfied = []
     for potential_issue in annotation.potential_issues:
+        # per-path annotation copies mean sibling end states each carry the
+        # same recorded issue; once one path confirmed it (detector cache
+        # hit, keyed like Issue.bytecode_hash), skip re-confirming the rest
+        try:
+            from mythril_tpu.utils.keccak import keccak256
+
+            raw = potential_issue.bytecode or b""
+            if isinstance(raw, str):
+                raw = bytes.fromhex(raw.removeprefix("0x"))
+            bytecode_hash = "0x" + keccak256(raw).hex()
+        except ValueError:
+            bytecode_hash = ""
+        if (potential_issue.address, bytecode_hash) in potential_issue.detector.cache:
+            continue
         try:
             from mythril_tpu.analysis.solver import get_transaction_sequence
 

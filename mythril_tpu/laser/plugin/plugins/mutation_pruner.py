@@ -8,7 +8,7 @@ from mythril_tpu.laser.plugin.interface import LaserPlugin, PluginBuilder
 from mythril_tpu.laser.plugin.signals import PluginSkipWorldState
 from mythril_tpu.laser.state.annotation import StateAnnotation
 from mythril_tpu.laser.transaction.models import ContractCreationTransaction
-from mythril_tpu.smt.solver.frontend import UnsatError
+from mythril_tpu.smt.solver.frontend import SolverTimeOutException, UnsatError
 from mythril_tpu.support.model import get_model
 
 log = logging.getLogger(__name__)
@@ -64,6 +64,9 @@ class MutationPruner(LaserPlugin):
                 # value can be zero: the tx is a no-op, drop the world state
                 raise PluginSkipWorldState
             except UnsatError:
+                return
+            except SolverTimeOutException:
+                # undecided: keep the world state (conservative)
                 return
 
         symbolic_vm.register_laser_hooks(

@@ -172,6 +172,10 @@ class BitVec(Expression):
             _union(self.annotations, other.annotations),
         )
 
+    # defining __eq__ sets __hash__ to None unless redeclared; hash by the
+    # interned raw term so BitVecs work as dict keys (symbolic storage slots)
+    __hash__ = Expression.__hash__
+
     def slt(self, other) -> "Bool":
         return self._cmp("bvslt", other)
 

@@ -53,6 +53,15 @@ class Bool(Expression):
     def __hash__(self):
         return hash(self.raw)
 
+    def __bool__(self):
+        # z3py-like truthiness: a concrete Bool is its value, any symbolic
+        # Bool is False. Dict keying of BitVecs works through this: eq()
+        # folds structurally-equal operands to TRUE at construction, so
+        # `a == b` on equal terms is already the concrete TRUE here.
+        if self.raw.is_const:
+            return bool(self.raw.value)
+        return False
+
 
 def And(*args) -> Bool:
     flat = args[0] if len(args) == 1 and isinstance(args[0], list) else args

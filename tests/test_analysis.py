@@ -253,3 +253,65 @@ def test_cli_exit_zero_when_clean():
         capture_output=True, text=True, timeout=300,
     )
     assert proc.returncode == 0
+
+
+def test_bitvec_hashable_as_dict_key():
+    """Regression: BitVec defines __eq__, so __hash__ must be redeclared
+    (symbolic storage slots are dict keys in printable_storage)."""
+    from mythril_tpu.smt import symbol_factory
+
+    key = symbol_factory.BitVecSym("slot", 256)
+    other = symbol_factory.BitVecSym("slot", 256)
+    store = {key: 1}
+    assert store[other] == 1  # same term -> same hash, __eq__ truthy on identity
+    different = symbol_factory.BitVecSym("slot2", 256)
+    assert different not in store
+
+
+def test_symbolic_slot_sstore_completes():
+    """Regression: SSTORE with a symbolic (calldata-derived) slot must not
+    crash on unhashable BitVec, and is an arbitrary-write finding."""
+    symslot = easm_to_code("""
+        PUSH1 0x01
+        PUSH1 0x00
+        CALLDATALOAD
+        SSTORE
+        STOP
+    """)
+    issues = analyze(wrap_creation(symslot), tx_count=1)
+    assert "124" in {i.swc_id for i in issues}
+
+
+def test_issue_confirmed_on_detection_path():
+    """Regression: a PotentialIssue recorded on one branch must be
+    concretized with that branch's transactions -- the final step of the
+    tx sequence carries the vulnerable function's selector, not whichever
+    sibling path happened to end its transaction first."""
+    two_fn = easm_to_code("""
+        PUSH1 0x00
+        CALLDATALOAD
+        PUSH1 0xe0
+        SHR
+        DUP1
+        PUSH4 0x41c0e1b5
+        EQ
+        PUSH1 @kill
+        JUMPI
+        DUP1
+        PUSH4 0xaabbccdd
+        EQ
+        PUSH1 @noop
+        JUMPI
+        STOP
+    :noop
+        JUMPDEST
+        STOP
+    :kill
+        JUMPDEST
+        CALLER
+        SELFDESTRUCT
+    """)
+    issues = analyze(wrap_creation(two_fn), tx_count=1)
+    issue = next(i for i in issues if i.swc_id == "106")
+    steps = issue.transaction_sequence["steps"]
+    assert steps[-1]["input"].startswith("0x41c0e1b5")
