@@ -13,10 +13,12 @@ solver wants.
 import logging
 
 from mythril_tpu.laser.strategy import BasicSearchStrategy
-from mythril_tpu.smt.solver.frontend import SolverTimeOutException, UnsatError
-from mythril_tpu.support.model import get_model, model_cache
+from mythril_tpu.support.model import get_models_batch
 
 log = logging.getLogger(__name__)
+
+# sibling states drained per batched solve — the device fan-out unit
+DRAIN_BATCH = 32
 
 
 class DelayConstraintStrategy(BasicSearchStrategy):
@@ -32,15 +34,19 @@ class DelayConstraintStrategy(BasicSearchStrategy):
         while not self.work_list:
             if not self.pending_worklist:
                 raise StopIteration
-            state = self.pending_worklist.pop(0)
-            try:
-                model = get_model(
-                    state.world_state.constraints.get_all_constraints())
-            except UnsatError:
-                continue
-            except SolverTimeOutException:
-                model = None  # unknown counts as possible: cannot prune
-            if model is not None:
-                model_cache.put(model)
-            self.work_list.append(state)
+            # drain a sibling-path bundle through ONE batched solve: with
+            # --solver-backend=tpu every eligible query rides a single
+            # run_round_batch device call (support/model.get_models_batch)
+            batch = self.pending_worklist[:DRAIN_BATCH]
+            del self.pending_worklist[:DRAIN_BATCH]
+            outcomes = get_models_batch(
+                [s.world_state.constraints.get_all_constraints()
+                 for s in batch]
+            )
+            for state, (status, _model) in zip(batch, outcomes):
+                if status == "unsat":
+                    continue  # proven unreachable: pruned
+                # sat (model already fed to the quick-sat cache by
+                # get_models_batch) or unknown (cannot prune): revive
+                self.work_list.append(state)
         return self.work_list.pop(0)

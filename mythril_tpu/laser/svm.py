@@ -196,27 +196,20 @@ class LaserEVM:
             # pending strategy probes the model cache before full solves
             # (reference constraint_strategy.py "delayed solving")
             if self.use_reachability_check and i > 0:
-                from mythril_tpu.laser.strategy.constraint_strategy import (
-                    DelayConstraintStrategy,
-                )
-                from mythril_tpu.support.model import model_cache
+                from mythril_tpu.support.model import get_models_batch
 
                 before = len(self.open_states)
-                base = self.strategy
-                while hasattr(base, "super_strategy"):
-                    base = base.super_strategy
-                if isinstance(base, DelayConstraintStrategy):
-                    self.open_states = [
-                        ws for ws in self.open_states
-                        if model_cache.check_quick_sat(
-                            ws.constraints.get_all_constraints()
-                        ) is not None or ws.constraints.is_possible
-                    ]
-                else:
-                    self.open_states = [
-                        ws for ws in self.open_states
-                        if ws.constraints.is_possible
-                    ]
+                # one batched solve over every open state (quick-sat cache
+                # probes happen inside get_models_batch; eligible leftovers
+                # ride a single device call under --solver-backend=tpu)
+                outcomes = get_models_batch(
+                    [ws.constraints.get_all_constraints()
+                     for ws in self.open_states]
+                )
+                self.open_states = [
+                    ws for ws, (status, _model) in zip(self.open_states, outcomes)
+                    if status != "unsat"
+                ]
                 log.info(
                     "tx %d: %d/%d open states reachable",
                     i + 1, len(self.open_states), before,
@@ -295,10 +288,18 @@ class LaserEVM:
                     and self.strategy.run_check()
                     and random.random() < pruning_factor
                 ):
+                    # ALL fork sides of this exec iteration go through one
+                    # batched solve (one device fan-out under
+                    # --solver-backend=tpu) instead of serial is_possible
+                    from mythril_tpu.support.model import get_models_batch
+
+                    outcomes = get_models_batch(
+                        [s.world_state.constraints.get_all_constraints()
+                         for s in new_states]
+                    )
                     new_states = [
-                        s
-                        for s in new_states
-                        if s.world_state.constraints.is_possible
+                        s for s, (status, _model) in zip(new_states, outcomes)
+                        if status != "unsat"
                     ]
                 elif not self.strategy.run_check():
                     # delayed-solving strategy: forks failing the quick

@@ -184,6 +184,9 @@ class Solver:
         self.constraints: List[Term] = []
         self._model: Optional[Model] = None
         self.conflict_budget = 0
+        # False = plain CDCL only (the batched device path sets this for
+        # leftover settling so solve_cnf doesn't re-enter the device)
+        self.allow_device = True
 
     def set_timeout(self, timeout_ms: int) -> None:
         self.timeout = timeout_ms / 1000.0
@@ -256,6 +259,7 @@ class Solver:
             assumptions=assumptions,
             timeout_seconds=self.timeout or 0.0,
             conflict_budget=self.conflict_budget,
+            allow_device=self.allow_device,
         )
         if status == SAT:
             prep.last_bits = bits

@@ -78,6 +78,7 @@ def solve_cnf(
     assumptions: Iterable[int] = (),
     timeout_seconds: float = 0.0,
     conflict_budget: int = 0,
+    allow_device: bool = True,
 ) -> Tuple[str, Optional[List[bool]]]:
     """Solve CNF with DIMACS-signed literals.
 
@@ -92,7 +93,7 @@ def solve_cnf(
     assumptions = list(assumptions)
     from mythril_tpu.support.args import args as _args
 
-    if _args.solver_backend == "tpu" and not conflict_budget:
+    if _args.solver_backend == "tpu" and not conflict_budget and allow_device:
         import time as _time
 
         start = _time.monotonic()

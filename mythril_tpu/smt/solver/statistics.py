@@ -13,6 +13,10 @@ class SolverStatistics:
             cls._instance.enabled = False
             cls._instance.query_count = 0
             cls._instance.solver_time = 0.0
+            cls._instance.batch_query_count = 0
+            cls._instance.device_batch_queries = 0
+            cls._instance.device_batch_hits = 0
+            cls._instance.device_ineligible = 0
         return cls._instance
 
     def add_query(self, seconds: float) -> None:
@@ -20,13 +24,42 @@ class SolverStatistics:
             self.query_count += 1
             self.solver_time += seconds
 
+    def add_batch(self, num_queries: int, seconds: float) -> None:
+        """One get_models_batch call covering num_queries sibling queries."""
+        if self.enabled:
+            self.batch_query_count += num_queries
+            self.solver_time += seconds
+
+    def add_device_batch_query(self, hit: bool) -> None:
+        """A query that reached the batched device solver (hit = model
+        found on device; miss = CDCL settled it)."""
+        if self.enabled:
+            self.device_batch_queries += 1
+            if hit:
+                self.device_batch_hits += 1
+
+    def add_device_ineligible(self) -> None:
+        """A query that could not take the device path (dense-cap/empty)."""
+        if self.enabled:
+            self.device_ineligible += 1
+
     def reset(self) -> None:
         self.query_count = 0
         self.solver_time = 0.0
+        self.batch_query_count = 0
+        self.device_batch_queries = 0
+        self.device_batch_hits = 0
+        self.device_ineligible = 0
 
     def __repr__(self):
-        return (f"Solver statistics: query count: {self.query_count}, "
-                f"solver time: {self.solver_time:.3f}")
+        out = (f"Solver statistics: query count: {self.query_count}, "
+               f"solver time: {self.solver_time:.3f}")
+        if self.batch_query_count:
+            out += (f", batched queries: {self.batch_query_count}"
+                    f", device-eligible: {self.device_batch_queries}"
+                    f" (hits: {self.device_batch_hits})"
+                    f", device-ineligible: {self.device_ineligible}")
+        return out
 
 
 def stat_smt_query(func):

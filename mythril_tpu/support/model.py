@@ -11,6 +11,7 @@ This is also the designed backend seam: `args.solver_backend` selects the
 batched TPU solver for eligible queries (with the CPU CDCL as oracle).
 """
 
+import time
 from collections import OrderedDict, deque
 from typing import Iterable, List, Optional
 
@@ -109,6 +110,135 @@ def get_model(
             _store_result(key, UNSAT)
         raise UnsatError()
     raise SolverTimeOutException()
+
+
+def get_models_batch(
+    constraint_sets,
+    enforce_execution_time: bool = True,
+    solver_timeout: Optional[int] = None,
+) -> List:
+    """Batched multi-query solve — THE production device fan-out.
+
+    Takes N constraint lists (sibling-path feasibility checks: drained
+    pending states, fork sides of one exec iteration) and returns N entries
+    of ("sat", Model) / ("unsat", None) / ("unknown", None).
+
+    Pipeline: result-cache + quick-sat probe per query on host; every
+    remaining eligible query is lowered/blasted and shipped to the device
+    in ONE run_round_batch call (no per-query CDCL pre-probe — the batch
+    IS the device's work); leftovers (device miss or dense-cap overflow)
+    are settled by the CDCL, which alone proves UNSAT.
+    """
+    from mythril_tpu.smt.solver.frontend import Solver
+    from mythril_tpu.smt.solver.statistics import SolverStatistics
+
+    stats = SolverStatistics()
+    results: List = [None] * len(constraint_sets)
+
+    timeout_ms = solver_timeout if solver_timeout is not None else args.solver_timeout
+    timeout_s = timeout_ms / 1000.0
+    if enforce_execution_time:
+        timeout_s = min(timeout_s, max(time_handler.time_remaining() - 0.5, 0.05))
+
+    pending: List[tuple] = []  # (idx, key, solver, prep)
+    start = time.monotonic()
+    for idx, constraints in enumerate(constraint_sets):
+        raw_constraints = [
+            c.raw if isinstance(c, Expression) else c for c in constraints
+        ]
+        key = _cache_key(raw_constraints)
+        if key is not None and key in _result_cache:
+            cached = _result_cache[key]
+            results[idx] = (
+                ("sat", cached) if isinstance(cached, Model) else ("unsat", None)
+            )
+            continue
+        quick = model_cache.check_quick_sat(raw_constraints)
+        if quick is not None:
+            results[idx] = ("sat", quick)
+            continue
+        solver = Solver(timeout=timeout_s)
+        solver.add(raw_constraints)
+        prep = solver._prepare([])
+        if prep.trivial is not None:
+            if prep.trivial == SAT:
+                model = Model({})
+                results[idx] = ("sat", model)
+                if key is not None:
+                    _store_result(key, model)
+            elif prep.trivial == UNSAT:
+                results[idx] = ("unsat", None)
+                if key is not None:
+                    _store_result(key, UNSAT)
+            else:
+                results[idx] = ("unknown", None)
+            continue
+        pending.append((idx, key, solver, prep))
+
+    if pending and args.solver_backend == "tpu":
+        from mythril_tpu.tpu import pack
+
+        eligible = []
+        ineligible = []
+        for entry in pending:
+            prep = entry[3]
+            if pack.fits_device(prep.num_vars, prep.clauses) and not any(
+                len(c) == 0 for c in prep.clauses
+            ):
+                eligible.append(entry)
+            else:
+                ineligible.append(entry)
+                stats.add_device_ineligible()
+        try:
+            from mythril_tpu.tpu.backend import get_device_backend
+
+            backend = get_device_backend()
+            problems = [(p.num_vars, p.clauses) for _, _, _, p in eligible]
+            bits_list = backend.try_solve_batch(
+                problems, budget_seconds=min(4.0, timeout_s))
+        except Exception as error:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "batched device solve failed (%s); CDCL fallback", error)
+            bits_list = [None] * len(eligible)
+        still_pending = list(ineligible)
+        for (idx, key, solver, prep), bits in zip(eligible, bits_list):
+            stats.add_device_batch_query(hit=bits is not None)
+            if bits is None:
+                still_pending.append((idx, key, solver, prep))
+                continue
+            try:
+                model = solver._reconstruct(
+                    prep.blaster, bits, prep.lowering, prep.original)
+            except Exception:
+                still_pending.append((idx, key, solver, prep))
+                continue
+            results[idx] = ("sat", model)
+            if key is not None:
+                _store_result(key, model)
+                model_cache.put(model)
+        pending = still_pending
+
+    # CDCL settles the rest (and proves UNSAT); plain path, no device re-entry
+    for idx, key, solver, prep in pending:
+        solver.allow_device = False
+        solver.timeout = max(0.05, timeout_s - (time.monotonic() - start))
+        status = solver._solve_prepared(prep)
+        if status == SAT:
+            model = solver.model()
+            results[idx] = ("sat", model)
+            if key is not None:
+                _store_result(key, model)
+                model_cache.put(model)
+        elif status == UNSAT:
+            results[idx] = ("unsat", None)
+            if key is not None:
+                _store_result(key, UNSAT)
+        else:
+            results[idx] = ("unknown", None)
+    stats.add_batch(len(constraint_sets), time.monotonic() - start)
+    return results
 
 
 def _store_result(key, value) -> None:

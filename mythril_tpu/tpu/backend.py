@@ -24,6 +24,10 @@ log = logging.getLogger(__name__)
 _backend = None
 _cache_enabled = False
 
+# max f32 elements per incidence matrix in one batched call (~256 MiB for
+# the A_pos/A_neg pair); queries past the cap take the CDCL path instead
+_BATCH_ELEMENT_BUDGET = 2 ** 25
+
 
 def _enable_compile_cache(jax) -> None:
     """Persist XLA executables across processes; first-compile latency for a
@@ -64,6 +68,9 @@ class DeviceSolverBackend:
         self.queries = 0
         self.sat_found = 0
         self.fallbacks = 0
+        self.batch_calls = 0
+        self.batch_queries = 0
+        self.batch_sat = 0
         self.device_seconds = 0.0
         self.flips = 0
         self._jax = None
@@ -95,7 +102,7 @@ class DeviceSolverBackend:
     ) -> Optional[List[bool]]:
         """Search for a model on device; None if not found in budget."""
         full = [tuple(c) for c in clauses] + [(a,) for a in assumptions]
-        if num_vars == 0 or not pack.fits_dense(num_vars, full):
+        if num_vars == 0 or not pack.fits_device(num_vars, full):
             return None
         if any(len(c) == 0 for c in full):
             return None  # trivially unsat; let CDCL report it
@@ -107,10 +114,25 @@ class DeviceSolverBackend:
             return None
         deadline = start + budget_seconds
 
-        packed = pack.PackedCNF(num_vars, full)
-        a_pos = jax.numpy.asarray(packed.a_pos)
-        a_neg = jax.numpy.asarray(packed.a_neg)
-        clause_mask = jax.numpy.asarray(packed.clause_mask)
+        if pack.fits_dense(num_vars, full):
+            packed = pack.PackedCNF(num_vars, full)
+            a_pos = jax.numpy.asarray(packed.a_pos)
+            a_neg = jax.numpy.asarray(packed.a_neg)
+            clause_mask = jax.numpy.asarray(packed.clause_mask)
+
+            def round_fn(x, round_key):
+                return walksat.run_round(
+                    a_pos, a_neg, clause_mask, x, round_key,
+                    steps=self.steps_per_round, noise=self.noise)
+        else:
+            packed = pack.PackedSparseCNF(num_vars, full)
+            lits = jax.numpy.asarray(packed.lits)
+            clause_mask = jax.numpy.asarray(packed.clause_mask)
+
+            def round_fn(x, round_key):
+                return walksat.run_round_sparse(
+                    lits, clause_mask, x, round_key,
+                    steps=self.steps_per_round, noise=self.noise)
 
         self._seed += 1
         key = jax.random.PRNGKey(self._seed)
@@ -121,10 +143,7 @@ class DeviceSolverBackend:
         rounds = 0
         while True:
             key, round_key = jax.random.split(key)
-            x, found = walksat.run_round(
-                a_pos, a_neg, clause_mask, x, round_key,
-                steps=self.steps_per_round, noise=self.noise,
-            )
+            x, found = round_fn(x, round_key)
             rounds += 1
             found_host = np.asarray(found)
             self.flips += self.num_restarts * self.steps_per_round
@@ -153,6 +172,154 @@ class DeviceSolverBackend:
         self.device_seconds += time.monotonic() - start
         return None
 
+    def try_solve_batch(
+        self,
+        problems: Sequence[Tuple[int, Sequence[Tuple[int, ...]]]],
+        budget_seconds: float = 4.0,
+    ) -> List[Optional[List[bool]]]:
+        """Solve many CNF queries in ONE device fan-out (the production
+        sibling-path bundle): pack every query to a shared batch shape, run
+        rounds of the vmapped kernel until all queries found a model or the
+        budget lapses. Small bundles take the dense matmul kernel (MXU);
+        bundles with any large query take the sparse literal-list kernel
+        (real analyze queries blast to ~100k vars — far past dense caps).
+        Returns per-query model bits (None = not found; caller's CDCL
+        settles those and alone proves UNSAT)."""
+        results: List[Optional[List[bool]]] = [None] * len(problems)
+        live: List[Tuple[int, int, list]] = []  # (orig idx, num_vars, clauses)
+        for qi, (num_vars, clauses) in enumerate(problems):
+            full = [tuple(c) for c in clauses]
+            if (num_vars == 0 or not pack.fits_device(num_vars, full)
+                    or any(len(c) == 0 for c in full)):
+                continue
+            live.append((qi, num_vars, full))
+        if not live:
+            return results
+        try:
+            jax, walksat = self._modules()
+        except Exception:
+            return results
+        start = time.monotonic()
+
+        dense = all(pack.fits_dense(nv, cl) for _, nv, cl in live)
+        if dense:
+            run = self._run_dense_batch
+        else:
+            run = self._run_sparse_batch
+        solved, found_host, x_host, live = run(jax, walksat, live,
+                                               start + budget_seconds)
+
+        for slot, (qi, num_vars, full) in enumerate(live):
+            if not solved[slot]:
+                continue
+            row = int(np.argmax(found_host[slot]))
+            bits = pack.model_bits_from_assignment(x_host[slot, row], num_vars)
+            if self._honors(bits, full):
+                results[qi] = bits
+                self.batch_sat += 1
+            else:
+                log.warning("batched device model failed host clause check")
+        self.device_seconds += time.monotonic() - start
+        return results
+
+    def _round_loop(self, jax, round_fn, x, keys, q_pad, n_live, v_pad,
+                    deadline):
+        """Shared host loop: run jitted rounds until all live queries are
+        solved or the budget lapses; returns (solved, found, x) on host."""
+        rounds = 0
+        key = jax.random.PRNGKey(self._seed ^ 0x5EED)
+        while True:
+            x, found = round_fn(x, keys)
+            rounds += 1
+            self.flips += q_pad * self.num_restarts * self.steps_per_round
+            found_host = np.asarray(found)  # [Q, R]
+            solved = found_host.any(axis=1)
+            if solved[:n_live].all() or time.monotonic() >= deadline:
+                return solved, found_host, np.asarray(x)
+            keys = jax.vmap(jax.random.fold_in)(
+                keys, jax.numpy.full((q_pad,), rounds, dtype=jax.numpy.uint32))
+            if rounds % 8 == 0:
+                key, re_key = jax.random.split(key)
+                fresh = jax.random.bernoulli(
+                    re_key, 0.5, x.shape).astype(np.float32)
+                half = self.num_restarts // 2
+                x = x.at[:, :half].set(fresh[:, :half])
+
+    def _batch_prologue(self, jax, n_live, v_pad):
+        self.batch_calls += 1
+        self.batch_queries += n_live
+        self._seed += 1
+        q_pad = 1
+        while q_pad < n_live:
+            q_pad *= 2
+        key = jax.random.PRNGKey(self._seed)
+        key, init_key = jax.random.split(key)
+        x = jax.random.bernoulli(
+            init_key, 0.5, (q_pad, self.num_restarts, v_pad)
+        ).astype(np.float32)
+        keys = jax.random.split(key, q_pad)
+        return q_pad, x, keys
+
+    def _run_dense_batch(self, jax, walksat, live, deadline):
+        packed = [pack.PackedCNF(nv, cl) for _, nv, cl in live]
+        c_pad = max(p.num_clauses_pad for p in packed)
+        v_pad = max(p.num_vars_pad for p in packed)
+        # cap the slab so Q * C * V stays within budget; overflow queries
+        # fall back to the caller's CDCL
+        max_q = max(1, _BATCH_ELEMENT_BUDGET // (c_pad * v_pad))
+        if len(live) > max_q:
+            live, packed = live[:max_q], packed[:max_q]
+        q_pad, x, keys = self._batch_prologue(jax, len(live), v_pad)
+
+        a_pos = np.zeros((q_pad, c_pad, v_pad), dtype=np.float32)
+        a_neg = np.zeros_like(a_pos)
+        clause_mask = np.zeros((q_pad, c_pad), dtype=np.float32)
+        for slot, p in enumerate(packed):
+            a_pos[slot, : p.num_clauses_pad, : p.num_vars_pad] = p.a_pos
+            a_neg[slot, : p.num_clauses_pad, : p.num_vars_pad] = p.a_neg
+            clause_mask[slot, : p.num_clauses_pad] = p.clause_mask
+        # padding slots have zero live clauses -> found at step 0, frozen
+        a_pos_d = jax.numpy.asarray(a_pos)
+        a_neg_d = jax.numpy.asarray(a_neg)
+        mask_d = jax.numpy.asarray(clause_mask)
+
+        def round_fn(x, keys):
+            return walksat.run_round_batch(
+                a_pos_d, a_neg_d, mask_d, x, keys,
+                steps=self.steps_per_round, noise=self.noise)
+
+        solved, found, x_host = self._round_loop(
+            jax, round_fn, x, keys, q_pad, len(live), v_pad, deadline)
+        return solved, found, x_host, live
+
+    def _run_sparse_batch(self, jax, walksat, live, deadline):
+        packed = [pack.PackedSparseCNF(nv, cl) for _, nv, cl in live]
+        c_pad = max(p.num_clauses_pad for p in packed)
+        v_pad = max(p.num_vars_pad for p in packed)
+        # gather intermediate is [Q, R, C, K]; budget Q accordingly
+        per_query = self.num_restarts * c_pad * pack.SPARSE_K
+        max_q = max(1, _BATCH_ELEMENT_BUDGET // per_query)
+        if len(live) > max_q:
+            live, packed = live[:max_q], packed[:max_q]
+        q_pad, x, keys = self._batch_prologue(jax, len(live), v_pad)
+
+        lits = np.zeros((q_pad, c_pad, pack.SPARSE_K), dtype=np.int32)
+        clause_mask = np.zeros((q_pad, c_pad), dtype=np.float32)
+        for slot, p in enumerate(packed):
+            lits[slot, : p.num_clauses_pad] = p.lits
+            clause_mask[slot, : p.num_clauses_pad] = p.clause_mask
+        lits_d = jax.numpy.asarray(lits)
+        mask_d = jax.numpy.asarray(clause_mask)
+
+        def round_fn(x, keys):
+            return walksat.run_round_sparse_batch(
+                lits_d, mask_d, x, keys,
+                steps=self.steps_per_round, noise=self.noise)
+
+        solved, found, x_host = self._round_loop(
+            jax, round_fn, x, keys, q_pad, len(live), v_pad, deadline)
+        return solved, found, x_host, live
+
     @staticmethod
     def _honors(bits: List[bool], clauses: Sequence[Tuple[int, ...]]) -> bool:
         for clause in clauses:
@@ -166,6 +333,9 @@ class DeviceSolverBackend:
             "queries": self.queries,
             "sat_found": self.sat_found,
             "fallbacks": self.fallbacks,
+            "batch_calls": self.batch_calls,
+            "batch_queries": self.batch_queries,
+            "batch_sat": self.batch_sat,
             "device_seconds": round(self.device_seconds, 4),
             "flips": self.flips,
             "flips_per_second": (

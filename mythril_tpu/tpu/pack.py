@@ -86,6 +86,72 @@ def fits_dense(num_vars: int, clauses: Sequence[Sequence[int]]) -> bool:
     return num_vars <= var_cap and len(clauses) <= clause_cap
 
 
+# Sparse-path capacity: per-query memory is [C, K] literals plus the
+# [R, C, K] gather intermediate, independent of V — real analyze queries
+# (~100k vars / ~200k clauses after blasting keccak-laden path constraints)
+# fit easily where dense [C, V] would be tens of GB.
+_SPARSE_CAPS = (1 << 17, 1 << 18)  # (vars, clauses)
+SPARSE_K = 4
+
+
+def sparse_caps() -> Tuple[int, int]:
+    return _SPARSE_CAPS
+
+
+def fits_sparse(num_vars: int, clauses: Sequence[Sequence[int]]) -> bool:
+    var_cap, clause_cap = _SPARSE_CAPS
+    # clause splitting can add clauses/vars; bound with the worst case
+    extra = sum(max(0, len(c) - SPARSE_K) for c in clauses)
+    return num_vars + extra <= var_cap and len(clauses) + extra <= clause_cap
+
+
+def fits_device(num_vars: int, clauses: Sequence[Sequence[int]]) -> bool:
+    """Eligibility for ANY device path (dense or sparse kernel)."""
+    return fits_dense(num_vars, clauses) or fits_sparse(num_vars, clauses)
+
+
+class PackedSparseCNF:
+    """One CNF as a padded [C, K] literal-list matrix.
+
+    Clauses longer than K are Tseitin-split with fresh relay variables:
+    (l1 .. ln) -> (l1 .. l_{K-1} a) & (-a l_K .. ln), recursively — sound
+    and complete, keeps K a compile-time constant for the kernel."""
+
+    __slots__ = ("num_vars", "total_vars", "num_clauses", "num_vars_pad",
+                 "num_clauses_pad", "lits", "clause_mask")
+
+    def __init__(self, num_vars: int, clauses: Sequence[Sequence[int]],
+                 var_floor: int = 128, clause_floor: int = 256,
+                 k: int = SPARSE_K):
+        self.num_vars = num_vars
+        split: List[Tuple[int, ...]] = []
+        next_var = num_vars
+        for clause in clauses:
+            clause = tuple(clause)
+            while len(clause) > k:
+                next_var += 1
+                split.append(clause[: k - 1] + (next_var,))
+                clause = (-next_var,) + clause[k - 1:]
+            split.append(clause)
+        self.total_vars = next_var
+        self.num_clauses = len(split)
+        var_cap, clause_cap = _SPARSE_CAPS
+        self.num_vars_pad = _bucket(max(next_var, 1), var_floor, var_cap)
+        self.num_clauses_pad = _bucket(max(len(split), 1), clause_floor,
+                                       clause_cap)
+        lits = np.zeros((self.num_clauses_pad, k), dtype=np.int32)
+        for ci, clause in enumerate(split):
+            lits[ci, : len(clause)] = clause
+        self.lits = lits
+        mask = np.zeros((self.num_clauses_pad,), dtype=np.float32)
+        mask[: len(split)] = 1.0
+        self.clause_mask = mask
+
+    @property
+    def shape_key(self) -> Tuple[int, int]:
+        return (self.num_clauses_pad, self.num_vars_pad)
+
+
 def pack_literal_lists(
     clauses: Sequence[Sequence[int]],
     max_len: Optional[int] = None,

@@ -118,3 +118,92 @@ def check_assignments(a_pos: jnp.ndarray, a_neg: jnp.ndarray,
     true_counts = x @ (a_pos - a_neg).T + jnp.sum(a_neg, axis=1)[None, :]
     unsat = clause_mask[None, :] * (true_counts < 0.5)
     return jnp.sum(unsat, axis=1) < 0.5
+
+
+# ---------------------------------------------------------------------------
+# sparse literal-list kernel — the path real analyze queries take.
+#
+# Blasted EVM path constraints run to ~100k vars / ~200k clauses; a dense
+# [C, V] incidence matrix would be tens of GB, but Tseitin clauses hold at
+# most 3-4 literals, so the sparse layout is [C, K] literal lists. The
+# per-step shape is gather (x at literal vars -> [R, C, K]) + masked
+# reductions + one segment-sum scatter back to [V] — all static shapes,
+# vectorized over restarts (and queries via vmap), no data-dependent
+# control flow.
+
+
+def _sparse_step(carry, step_key, var_idx, sign_pos, lit_mask, clause_mask,
+                 num_vars_pad, noise):
+    x, found = carry
+    xv = jnp.take(x, var_idx, axis=1)                     # [R, C, K]
+    lit_true = jnp.where(sign_pos, xv, 1.0 - xv) * lit_mask
+    true_counts = lit_true.sum(-1)                        # [R, C]
+    live = clause_mask[None, :]
+    unsat = live * (true_counts < 0.5)
+    newly_found = jnp.sum(unsat, axis=1) < 0.5
+    found = found | newly_found
+    critical = live * (jnp.abs(true_counts - 1.0) < 0.5)
+
+    R = x.shape[0]
+    flat_idx = var_idx.reshape(-1)                        # [C*K]
+
+    def scatter(vals):                                    # [R, C, K] -> [R, V]
+        flat = vals.reshape(R, -1).T                      # [C*K, R]
+        out = jax.ops.segment_sum(flat, flat_idx, num_segments=num_vars_pad)
+        return out.T                                      # [R, V]
+
+    # break[r,v]: critical clause's single TRUE literal sits on v
+    breaks = scatter(lit_true * critical[:, :, None])
+    # make[r,v] == occurrence[r,v]: v appears (any polarity, all lits false)
+    # in an unsat clause — flipping v satisfies it
+    occurrence = scatter(lit_mask * unsat[:, :, None])
+    makes = occurrence
+    candidate = occurrence > 0.5
+
+    k_greedy, k_rand, k_choice = jax.random.split(step_key, 3)
+    score = jnp.where(candidate, makes - breaks, NEG_INF)
+    gumbel = jax.random.gumbel(k_greedy, score.shape) * 0.01
+    v_greedy = jnp.argmax(score + gumbel, axis=1)
+    logits = jnp.where(candidate, jnp.log(occurrence + 1e-6), NEG_INF)
+    v_rand = jax.random.categorical(k_rand, logits, axis=1)
+    use_rand = jax.random.bernoulli(k_choice, noise, (R,))
+    v_flip = jnp.where(use_rand, v_rand, v_greedy)
+
+    flip = jax.nn.one_hot(v_flip, x.shape[1], dtype=x.dtype)
+    flip = flip * (1.0 - found[:, None])
+    x = x * (1.0 - flip) + (1.0 - x) * flip
+    return (x, found), None
+
+
+@functools.partial(jax.jit, static_argnames=("steps", "noise"))
+def run_round_sparse(lits: jnp.ndarray, clause_mask: jnp.ndarray,
+                     x: jnp.ndarray, key: jnp.ndarray,
+                     steps: int = 64, noise: float = 0.35
+                     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Advance restarts by `steps` flips on a sparse-packed CNF.
+
+    `lits` [C, K] DIMACS literals (0 = padding), `clause_mask` [C],
+    `x` [R, V_pad]."""
+    var_idx = jnp.clip(jnp.abs(lits) - 1, 0, x.shape[1] - 1)
+    sign_pos = lits > 0
+    lit_mask = (lits != 0).astype(x.dtype)
+    step = functools.partial(
+        _sparse_step, var_idx=var_idx, sign_pos=sign_pos, lit_mask=lit_mask,
+        clause_mask=clause_mask, num_vars_pad=x.shape[1], noise=noise,
+    )
+    keys = jax.random.split(key, steps)
+    found0 = jnp.sum(x, axis=1) < -1.0
+    (x, found), _ = lax.scan(lambda c, k: step(c, k), (x, found0), keys)
+    return x, found
+
+
+@functools.partial(jax.jit, static_argnames=("steps", "noise"))
+def run_round_sparse_batch(lits: jnp.ndarray, clause_mask: jnp.ndarray,
+                           x: jnp.ndarray, keys: jnp.ndarray,
+                           steps: int = 64, noise: float = 0.35
+                           ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """[Q, C, K] sparse queries — the large-query sibling-path fan-out."""
+    return jax.vmap(
+        lambda ll, cm, xx, kk: run_round_sparse(ll, cm, xx, kk,
+                                                steps=steps, noise=noise)
+    )(lits, clause_mask, x, keys)
