@@ -81,7 +81,19 @@ class DetectionModule:
                 )
                 from mythril_tpu.smt import And
 
+                # modules that solved precise conditions (e.g. suicide's
+                # attacker constraints) annotate themselves; only add the
+                # coarse reachability fallback for issues they didn't, or a
+                # weaker duplicate could confirm a false positive on
+                # substituted re-solving
+                already = {
+                    id(a.issue)
+                    for a in target.annotations
+                    if isinstance(a, IssueAnnotation)
+                }
                 for issue in result:
+                    if id(issue) in already:
+                        continue
                     target.annotate(IssueAnnotation(
                         conditions=[And(
                             *target.world_state.constraints)],

@@ -31,13 +31,19 @@ class ModuleLoader:
     ) -> List[DetectionModule]:
         result = self._modules[:]
         if white_list:
-            available = {module.name for module in result}
+            # accept both the reference's class names (`-m Exceptions`,
+            # reference loader.py:65-79) and our internal snake_case names
+            def names_of(module):
+                return {module.name, type(module).__name__}
+
+            available = set().union(*(names_of(m) for m in result))
             unknown = set(white_list) - available
             if unknown:
                 raise ValueError(
                     f"unknown detection module(s): {', '.join(sorted(unknown))}"
                 )
-            result = [m for m in result if m.name in white_list]
+            wanted = set(white_list)
+            result = [m for m in result if names_of(m) & wanted]
         if entry_point:
             result = [m for m in result if m.entry_point == entry_point]
         return result

@@ -1,17 +1,24 @@
 """AccidentallyKillable — SWC-106 unprotected SELFDESTRUCT
-(reference analysis/module/modules/suicide.py:125)."""
+(reference analysis/module/modules/suicide.py:125).
+
+Issues are confirmed immediately via get_transaction_sequence (the reference
+does NOT route this module through PotentialIssue — suicide.py:70-95) so a
+SELFDESTRUCT reached during the creation transaction is still reported even
+though creation txs ending in SELFDESTRUCT never reach
+check_potential_issues (svm gating on transaction.return_data)."""
 
 import logging
+from typing import List
 
+from mythril_tpu.analysis import solver
+from mythril_tpu.analysis.issue_annotation import IssueAnnotation
 from mythril_tpu.analysis.module.base import DetectionModule, EntryPoint
-from mythril_tpu.analysis.potential_issues import (
-    PotentialIssue,
-    get_potential_issues_annotation,
-)
+from mythril_tpu.analysis.report import Issue
 from mythril_tpu.analysis.swc_data import UNPROTECTED_SELFDESTRUCT
+from mythril_tpu.laser.transaction.models import ContractCreationTransaction
 from mythril_tpu.laser.transaction.symbolic import ACTORS
-from mythril_tpu.smt.solver.frontend import UnsatError
-from mythril_tpu.support.model import get_model
+from mythril_tpu.smt import And
+from mythril_tpu.smt.solver.frontend import SolverTimeOutException, UnsatError
 
 log = logging.getLogger(__name__)
 
@@ -32,39 +39,49 @@ class AccidentallyKillable(DetectionModule):
     entry_point = EntryPoint.CALLBACK
     pre_hooks = ["SELFDESTRUCT"]
 
-    def _analyze_state(self, state):
+    def _analyze_state(self, state) -> List[Issue]:
+        log.debug(
+            "SELFDESTRUCT in function %s",
+            state.environment.active_function_name,
+        )
         instruction = state.get_current_instruction()
         to = state.mstate.stack[-1]
 
         attacker_constraints = []
         for tx in state.world_state.transaction_sequence:
-            if not isinstance(tx.caller, int) and tx.caller.symbolic:
-                attacker_constraints.append(tx.caller == ACTORS.attacker)
+            if not isinstance(tx, ContractCreationTransaction):
+                attacker_constraints.append(
+                    And(tx.caller == ACTORS.attacker, tx.caller == tx.origin)
+                )
 
         try:
-            # strongest variant: attacker also receives the funds
-            constraints = attacker_constraints + [to == ACTORS.attacker]
-            get_model(
-                state.world_state.constraints.get_all_constraints() + constraints
-            )
-            description_tail = (
-                DESCRIPTION_TAIL
-                + " The attacker controls the beneficiary address."
-            )
-        except UnsatError:
             try:
-                constraints = attacker_constraints
-                get_model(
-                    state.world_state.constraints.get_all_constraints()
-                    + constraints
+                # strongest variant: attacker also receives the funds
+                constraints = (
+                    list(state.world_state.constraints)
+                    + [to == ACTORS.attacker]
+                    + attacker_constraints
+                )
+                transaction_sequence = solver.get_transaction_sequence(
+                    state, constraints
+                )
+                description_tail = (
+                    DESCRIPTION_TAIL
+                    + " The attacker controls the beneficiary address."
+                )
+            except UnsatError:
+                constraints = (
+                    list(state.world_state.constraints) + attacker_constraints
+                )
+                transaction_sequence = solver.get_transaction_sequence(
+                    state, constraints
                 )
                 description_tail = DESCRIPTION_TAIL
-            except UnsatError:
-                return []
-        except Exception:
+        except (UnsatError, SolverTimeOutException):
+            log.debug("no model found for SELFDESTRUCT reachability")
             return []
 
-        potential_issue = PotentialIssue(
+        issue = Issue(
             contract=state.environment.active_account.contract_name,
             function_name=state.environment.active_function_name,
             address=instruction.address,
@@ -74,10 +91,12 @@ class AccidentallyKillable(DetectionModule):
             bytecode=state.environment.code.bytecode,
             description_head=DESCRIPTION_HEAD,
             description_tail=description_tail,
-            constraints=constraints,
-            detector=self,
+            transaction_sequence=transaction_sequence,
+            gas_used=(state.mstate.min_gas_used, state.mstate.max_gas_used),
         )
-        get_potential_issues_annotation(state).potential_issues.append(
-            potential_issue
+        state.annotate(
+            IssueAnnotation(
+                conditions=[And(*constraints)], issue=issue, detector=self
+            )
         )
-        return []
+        return [issue]

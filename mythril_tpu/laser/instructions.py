@@ -500,10 +500,7 @@ def _copy_to_memory(global_state, mem_offset, data_offset, length, reader):
         )
 
 
-@op("CALLDATACOPY")
-def calldatacopy_(global_state):
-    s = global_state.mstate.stack
-    mem_offset, data_offset, length = s.pop(), s.pop(), s.pop()
+def _calldata_copy(global_state, mem_offset, data_offset, length):
     calldata = global_state.environment.calldata
 
     def reader(base, i):
@@ -513,13 +510,48 @@ def calldatacopy_(global_state):
         return calldata[base_c + i]
 
     _copy_to_memory(global_state, mem_offset, data_offset, length, reader)
+
+
+@op("CALLDATACOPY")
+def calldatacopy_(global_state):
+    s = global_state.mstate.stack
+    mem_offset, data_offset, length = s.pop(), s.pop(), s.pop()
+    if _in_creation_tx(global_state):
+        # creation calldata is a modelling fiction holding constructor args;
+        # a real CALLDATACOPY during creation copies nothing useful
+        # (reference instructions.py:887-889)
+        return advance(global_state)
+    _calldata_copy(global_state, mem_offset, data_offset, length)
     return advance(global_state)
+
+
+def _in_creation_tx(global_state) -> bool:
+    from mythril_tpu.laser.transaction.models import ContractCreationTransaction
+
+    return isinstance(
+        global_state.current_transaction, ContractCreationTransaction
+    )
 
 
 @op("CODESIZE")
 def codesize_(global_state):
     code = global_state.environment.code
-    global_state.mstate.stack.append(bv(len(code.bytecode)))
+    code_size = len(code.bytecode)
+    if _in_creation_tx(global_state):
+        # constructor args sit past the init code: report init-code size plus
+        # room for them, pinning symbolic calldata's size so selector reads
+        # stay consistent (reference instructions.py:989-1000)
+        calldata = global_state.environment.calldata
+        from mythril_tpu.laser.state.calldata import ConcreteCalldata
+
+        if isinstance(calldata, ConcreteCalldata):
+            code_size += calldata.size
+        else:
+            code_size += 0x200  # space for 16 32-byte constructor args
+            global_state.world_state.constraints.append(
+                calldata.calldatasize == bv(code_size)
+            )
+    global_state.mstate.stack.append(bv(code_size))
     return advance(global_state)
 
 
@@ -528,6 +560,23 @@ def codecopy_(global_state):
     s = global_state.mstate.stack
     mem_offset, code_offset, length = s.pop(), s.pop(), s.pop()
     bytecode = global_state.environment.code.bytecode
+    code_size = len(bytecode)
+
+    if _in_creation_tx(global_state):
+        # reads past the init code are constructor-argument reads; serve them
+        # from the (symbolic) creation calldata (reference :1093-1127)
+        from mythril_tpu.laser.state.calldata import SymbolicCalldata
+
+        code_offset_c = concrete_or_none(code_offset)
+        if (
+            isinstance(global_state.environment.calldata, SymbolicCalldata)
+            and code_offset_c is not None
+            and code_offset_c >= code_size
+        ):
+            _calldata_copy(
+                global_state, mem_offset, bv(code_offset_c - code_size), length
+            )
+            return advance(global_state)
 
     def reader(base, i):
         base_c = concrete_or_none(base) if isinstance(base, BitVec) else base

@@ -7,7 +7,11 @@ the engine to pop the frame (reference :199-208, svm.py:475-519)."""
 from typing import List, Optional
 
 from mythril_tpu.disasm import Disassembly
-from mythril_tpu.laser.state.calldata import BaseCalldata, ConcreteCalldata
+from mythril_tpu.laser.state.calldata import (
+    BaseCalldata,
+    ConcreteCalldata,
+    SymbolicCalldata,
+)
 from mythril_tpu.laser.state.environment import Environment
 from mythril_tpu.laser.state.global_state import GlobalState
 from mythril_tpu.laser.state.machine_state import MachineState
@@ -93,7 +97,11 @@ class BaseTransaction:
         if call_data is not None:
             self.call_data = call_data
         elif init_call_data:
-            self.call_data = ConcreteCalldata(self.id, [])
+            # Default to symbolic calldata — the reference does this even for
+            # creation txs ("easier to model the calldata symbolically",
+            # transaction_models.py:112-113, symbolic.py:173-175) and
+            # compensates in CODESIZE/CODECOPY/CALLDATACOPY.
+            self.call_data = SymbolicCalldata(self.id)
         else:
             self.call_data = None
         self.code = code

@@ -114,7 +114,6 @@ def execute_contract_creation(
 ) -> "Account":
     """Symbolic creation tx from the CREATOR actor (reference :151-196)."""
     from mythril_tpu.disasm import Disassembly
-    from mythril_tpu.laser.state.calldata import ConcreteCalldata
 
     world_state = world_state or WorldState()
     open_states = [world_state]
@@ -140,7 +139,10 @@ def execute_contract_creation(
             caller=ACTORS.creator,
             origin=ACTORS.creator,
             code=Disassembly(code_bytes),
-            call_data=ConcreteCalldata(tx_id := "0", []),
+            # symbolic calldata on purpose — constructor args live past the
+            # init code and are modelled via CODESIZE/CODECOPY special cases
+            # (reference symbolic.py:173-175)
+            call_data=None,
             gas_price=None,
             call_value=symbol_factory.BitVecSym("creation_value", 256),
             prev_world_state=prev_world_state,

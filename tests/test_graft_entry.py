@@ -14,6 +14,32 @@ def test_entry_compiles_and_runs():
 
 
 def test_dryrun_multichip_solves_on_mesh():
-    # conftest pins an 8-device virtual CPU platform; the dryrun's own
-    # platform forcing must be a no-op on top of that
-    graft.dryrun_multichip(8)
+    # Run in a subprocess: once any in-process test has initialized the JAX
+    # backend (possibly on the real TPU), platform forcing is a no-op, so the
+    # 8-device CPU mesh must be claimed by a fresh interpreter.
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MYTHRIL_TPU_RESTARTS"] = "16"
+    xla_flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in xla_flags:
+        env["XLA_FLAGS"] = (
+            xla_flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    result = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__; __graft_entry__.dryrun_multichip(8)"],
+        cwd=repo_root,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert result.returncode == 0, (
+        f"dryrun_multichip failed:\nstdout:\n{result.stdout}\n"
+        f"stderr:\n{result.stderr}"
+    )

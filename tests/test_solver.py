@@ -187,3 +187,45 @@ def test_keccak_style_query():
     s = Solver(timeout=30)
     s.add(result == val(0x1234), UGT(data, 0))
     assert s.check() == "sat"
+
+
+def test_symbolic_bool_truthiness_raises():
+    """z3py semantics: `if symbolic_bool:` is a logic bug, not silent False
+    (round-2 verdict weak #5)."""
+    a, b = bv("tb_a"), bv("tb_b")
+    cond = a == b
+    with pytest.raises(TypeError):
+        bool(cond)
+    # concrete Bools still convert
+    assert bool(val(1) == val(1))
+    assert not bool(val(1) == val(2))
+
+
+def test_result_cache_verifies_equality_on_hit():
+    """A crafted hash collision between two different constraint sets must
+    not alias their sat/unsat verdicts (round-2 verdict weak #6)."""
+    from mythril_tpu.smt.terms import Term
+    from mythril_tpu.support import model as model_mod
+    from mythril_tpu.support.model import get_model, UnsatError
+
+    from mythril_tpu.smt import And
+
+    x = bv("cc_x", 8)
+    sat_c = [x == val(5, 8)]
+    # one constraint, same set size as sat_c, but unsatisfiable
+    unsat_c = [And(x == val(5, 8), x == val(6, 8))]
+
+    # Force both (equal-length) constraint sets onto colliding hashes: under
+    # the old hash-only key both map to the key (42,) and the second lookup
+    # would alias the first's SAT verdict.
+    real_hash = Term.__hash__
+    try:
+        Term.__hash__ = lambda self: 42
+        model_mod._result_cache.clear()
+        m = get_model(sat_c)
+        assert m.eval_int((x == val(5, 8)).raw) in (1, True)
+        with pytest.raises(UnsatError):
+            get_model(unsat_c)
+    finally:
+        Term.__hash__ = real_hash
+        model_mod._result_cache.clear()
