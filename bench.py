@@ -8,14 +8,18 @@ lowered and bit-blasted by the production pipeline
 (smt/solver/frontend.py), then:
 
   host   — the C++ CDCL (native/sat.cpp) solves queries one by one;
-  device — walksat.run_round_batch advances all queries at once (restarts
-           x queries in one jitted program of MXU matmuls); unsolved or
-           UNSAT queries fall back to the CDCL, and that fallback time is
-           charged to the device measurement.
+  device — the justification-based circuit-SLS kernel (tpu/circuit.py)
+           advances all queries at once. Circuit tensors are packed and
+           device_put ONCE before the timed loop (round-2 verdict: the
+           old bench re-shipped ~2 GB of incidence slabs every round —
+           a measured 3,116x slowdown). UNSAT/unsolved queries fall back
+           to the CDCL, charged to the device measurement.
 
 Prints ONE json line:
   {"metric": "sat_checks_per_sec", "value": <device rate>,
-   "unit": "checks/s", "vs_baseline": <device rate / host CDCL rate>}
+   "unit": "checks/s", "vs_baseline": <device rate / host CDCL rate>,
+   "extra": {device_solved, flips_per_sec, rounds, host_rate,
+             analyze_wall_cpu_s, analyze_wall_tpu_s}}
 
 The device leg runs in a subprocess with a timeout so a wedged TPU tunnel
 degrades to the host measurement (vs_baseline 1.0) instead of hanging.
@@ -30,13 +34,14 @@ import time
 NUM_QUERIES = int(os.environ.get("BENCH_QUERIES", 32))
 RESTARTS = int(os.environ.get("BENCH_RESTARTS", 16))
 BITS = 64
-STEPS = 64
-MAX_ROUNDS = 12
+STEPS = 192
+MAX_ROUNDS = 8
 DEVICE_TIMEOUT_S = 900
+ANALYZE_INPUT = "/root/reference/tests/testdata/inputs/flag_array.sol.o"
 
 
 def build_queries(num_queries: int = NUM_QUERIES):
-    """Deterministic (num_vars, clauses, expect_sat) CNF batch."""
+    """Deterministic CNF+AIG batch via the production blasting pipeline."""
     from mythril_tpu.smt import symbol_factory
     from mythril_tpu.smt.solver.frontend import Solver
 
@@ -55,68 +60,80 @@ def build_queries(num_queries: int = NUM_QUERIES):
             solver.add(value + data != sender)
         prep = solver._prepare([])
         assert prep.trivial is None
-        out.append((prep.num_vars, prep.clauses))
+        out.append(prep)
     return out
 
 
-def host_rate(queries):
+def host_rate(preps):
     from mythril_tpu.smt.solver import sat_backend
 
     start = time.monotonic()
     verdicts = []
-    for num_vars, clauses in queries:
-        status, _ = sat_backend.solve_cnf(num_vars, clauses,
-                                          timeout_seconds=60.0)
+    for prep in preps:
+        status, _ = sat_backend.solve_cnf(
+            prep.num_vars, prep.clauses, timeout_seconds=60.0,
+            allow_device=False)
         verdicts.append(status)
     wall = time.monotonic() - start
-    return len(queries) / wall, wall, verdicts
+    return len(preps) / wall, wall, verdicts
 
 
-def device_rate(queries):
+def device_rate(preps):
     import jax
     import numpy as np
 
     from mythril_tpu.smt.solver import sat_backend
-    from mythril_tpu.tpu import pack, walksat
+    from mythril_tpu.tpu import circuit
     from mythril_tpu.tpu.backend import DeviceSolverBackend, \
         _enable_compile_cache
 
     _enable_compile_cache(jax)
-    v_pad = c_pad = 0
-    packed = [pack.PackedCNF(nv, cl) for nv, cl in queries]
-    for p in packed:
-        v_pad = max(v_pad, p.num_vars_pad)
-        c_pad = max(c_pad, p.num_clauses_pad)
+    packed = [
+        circuit.PackedCircuit(p.blaster.aig, p.blaster.last_roots)
+        for p in preps
+    ]
+    assert all(p.ok for p in packed)
     q = len(packed)
-    a_pos = np.zeros((q, c_pad, v_pad), dtype=np.float32)
-    a_neg = np.zeros_like(a_pos)
-    clause_mask = np.zeros((q, c_pad), dtype=np.float32)
-    for qi, p in enumerate(packed):
-        a_pos[qi, : p.a_pos.shape[0], : p.a_pos.shape[1]] = p.a_pos
-        a_neg[qi, : p.a_neg.shape[0], : p.a_neg.shape[1]] = p.a_neg
-        clause_mask[qi, : p.clause_mask.shape[0]] = p.clause_mask
+    n_levels = max(p.num_levels for p in packed)
+    width = max(p.max_width for p in packed)
+    v1 = max(p.v1 for p in packed)
+    n_roots = max(p.num_roots for p in packed)
+    walk_depth = n_levels + 4
+
+    batch = {
+        k: np.stack([
+            p.padded_to(n_levels, width, v1, n_roots)[k] for p in packed
+        ])
+        for k in circuit.TENSOR_KEYS
+    }
+    # resident ONCE — never re-shipped inside the timed loop
+    tensors = {k: jax.device_put(jax.numpy.asarray(v))
+               for k, v in batch.items()}
+    key = jax.random.PRNGKey(7)
+    keys = jax.random.split(key, q)
+    x = jax.device_put(jax.random.bernoulli(
+        jax.random.PRNGKey(11), 0.5, (q, RESTARTS, v1)
+    ).astype(jax.numpy.int32))
 
     # the CPU platform only smoke-tests the path (driver runs this on TPU)
     on_cpu = jax.default_backend() == "cpu"
-    steps = 8 if on_cpu else STEPS
-    max_rounds = 1 if on_cpu else MAX_ROUNDS
-
-    key = jax.random.PRNGKey(7)
-    keys = jax.random.split(key, q)
-    x = jax.random.bernoulli(
-        jax.random.PRNGKey(11), 0.5, (q, RESTARTS, v_pad)
-    ).astype(np.float32)
+    steps = 32 if on_cpu else STEPS
+    max_rounds = 2 if on_cpu else MAX_ROUNDS
 
     # warm the jit cache before timing (driver: first compile 20-40 s)
-    jax.block_until_ready(walksat.run_round_batch(
-        a_pos, a_neg, clause_mask, x, keys, steps=steps))
+    jax.block_until_ready(circuit.run_round_circuit_batch(
+        tensors, x, keys, steps=steps, walk_depth=walk_depth))
 
     start = time.monotonic()
     solved = np.zeros((q,), dtype=bool)
+    flips = 0
+    rounds = 0
     for round_i in range(max_rounds):
         keys = jax.vmap(lambda k: jax.random.fold_in(k, round_i))(keys)
-        x, found = walksat.run_round_batch(
-            a_pos, a_neg, clause_mask, x, keys, steps=steps)
+        x, found = circuit.run_round_circuit_batch(
+            tensors, x, keys, steps=steps, walk_depth=walk_depth)
+        rounds += 1
+        flips += q * RESTARTS * steps
         solved |= np.asarray(found).any(axis=1)
         if solved.all():
             break
@@ -124,36 +141,69 @@ def device_rate(queries):
     x_np = np.asarray(x)
     checker = DeviceSolverBackend._honors
     verdicts = []
+    device_solved = 0
     for qi, p in enumerate(packed):
         bits = None
         if solved[qi] and found_np[qi].any():
             row = int(np.argmax(found_np[qi]))
-            bits = pack.model_bits_from_assignment(
-                x_np[qi, row], queries[qi][0])
-            if not checker(bits, queries[qi][1]):
+            assignment = x_np[qi, row]
+            bits = [False] * (preps[qi].num_vars + 1)
+            for var in range(1, preps[qi].num_vars + 1):
+                bits[var] = bool(assignment[var])
+            if not checker(bits, preps[qi].clauses):
                 bits = None
         if bits is not None:
+            device_solved += 1
             verdicts.append("sat")
         else:  # unsolved or UNSAT: the CDCL oracle decides (charged here)
             status, _ = sat_backend.solve_cnf(
-                queries[qi][0], queries[qi][1], timeout_seconds=60.0)
+                preps[qi].num_vars, preps[qi].clauses, timeout_seconds=60.0,
+                allow_device=False)
             verdicts.append(status)
     wall = time.monotonic() - start
-    return len(queries) / wall, wall, verdicts, int(solved.sum())
+    return {
+        "rate": len(preps) / wall,
+        "wall": wall,
+        "verdicts": verdicts,
+        "device_solved": device_solved,
+        "flips_per_sec": int(flips / wall) if wall else 0,
+        "rounds": rounds,
+    }
+
+
+def analyze_wall(backend: str) -> float:
+    """Wall-clock of a full `analyze` run on a pinned reference input."""
+    if not os.path.isfile(ANALYZE_INPUT):
+        return -1.0
+    start = time.monotonic()
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "mythril_tpu", "analyze",
+             "-f", ANALYZE_INPUT, "-t", "1", "-o", "json",
+             "--solver-backend", backend],
+            capture_output=True, text=True, timeout=600,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except (subprocess.SubprocessError, OSError):
+        return -4.0  # hung/crashed analyze leg: report, don't crash bench
+    wall = time.monotonic() - start
+    try:
+        issues = json.loads(proc.stdout.strip().splitlines()[-1])["issues"]
+        if not issues:
+            return -2.0  # lost the finding: report as failure, not speed
+    except Exception:
+        return -3.0
+    return wall
 
 
 def child_main():
-    queries = build_queries()
-    rate, wall, verdicts, device_solved = device_rate(queries)
-    print(json.dumps({
-        "rate": rate, "wall": wall, "verdicts": verdicts,
-        "device_solved": device_solved,
-    }))
+    preps = build_queries()
+    print(json.dumps(device_rate(preps)))
 
 
 def main():
-    queries = build_queries()
-    h_rate, h_wall, h_verdicts = host_rate(queries)
+    preps = build_queries()
+    h_rate, h_wall, h_verdicts = host_rate(preps)
 
     result = None
     try:
@@ -167,17 +217,32 @@ def main():
     except (subprocess.SubprocessError, OSError, ValueError):
         result = None
 
+    analyze_cpu = analyze_wall("cpu")
+    analyze_tpu = analyze_wall("tpu")
+
+    extra = {
+        "host_rate": round(h_rate, 2),
+        "analyze_wall_cpu_s": round(analyze_cpu, 2),
+        "analyze_wall_tpu_s": round(analyze_tpu, 2),
+    }
     if result is not None and result["verdicts"] == h_verdicts:
         value = result["rate"]
         vs = result["rate"] / h_rate if h_rate else 0.0
+        extra.update({
+            "device_solved": result["device_solved"],
+            "flips_per_sec": result["flips_per_sec"],
+            "rounds": result["rounds"],
+        })
     else:  # device leg unavailable (wedged tunnel) or verdict mismatch
         value = h_rate
         vs = 1.0
+        extra["device_leg"] = "unavailable-or-mismatch"
     print(json.dumps({
         "metric": "sat_checks_per_sec",
         "value": round(value, 2),
         "unit": "checks/s",
         "vs_baseline": round(vs, 3),
+        "extra": extra,
     }))
 
 

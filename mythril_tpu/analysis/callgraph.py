@@ -46,11 +46,12 @@ def generate_graph(sym, physics: bool = False, phrackify: bool = False) -> str:
             instruction = state.get_current_instruction()
             if instruction is None:
                 continue
-            arg = (
-                f" 0x{instruction.argument.hex()}"
-                if instruction.argument is not None
-                else ""
-            )
+            if isinstance(instruction.argument, bytes):
+                arg = f" 0x{instruction.argument.hex()}"
+            elif instruction.argument is not None:
+                arg = " <symbolic>"  # deploy-time-patched operand
+            else:
+                arg = ""
             code_lines.append(f"{instruction.address} {instruction.opcode}{arg}")
         label = f"{node.function_name}\\n" + "\\n".join(code_lines[:16])
         nodes.append({"id": node.uid, "label": label})

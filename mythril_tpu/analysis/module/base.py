@@ -27,6 +27,9 @@ class DetectionModule:
     def __init__(self):
         self.issues: List = []
         self.cache: Set[Tuple[int, bytes]] = set()
+        # modules managing their own dedupe (e.g. Exceptions keying by last
+        # JUMP) set this False (reference base.py auto_cache)
+        self.auto_cache: bool = True
         # hook context, set per-invocation by execute(): which opcode fired
         # the hook and whether it was a pre- or post-hook (post-hooks see the
         # state AFTER execution, pc already advanced)
@@ -62,7 +65,11 @@ class DetectionModule:
         if self.entry_point == EntryPoint.CALLBACK:
             self.current_opcode = opcode
             self.is_prehook = prehook
-            if prehook and self._cache_key(target) in self.cache:
+            if (
+                self.auto_cache
+                and prehook
+                and self._cache_key(target) in self.cache
+            ):
                 return None
             result = self._analyze_state(target)
         else:
@@ -102,7 +109,8 @@ class DetectionModule:
                     ))
                 return result
             self.issues.extend(result)
-            self.update_cache(result)
+            if self.auto_cache:
+                self.update_cache(result)
         return result
 
     def _analyze_state(self, global_state) -> List:

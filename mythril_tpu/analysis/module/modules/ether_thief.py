@@ -37,20 +37,21 @@ class EtherThief(DetectionModule):
         if instruction is None:  # CALL was the last instruction of the code
             return []
 
-        constraints = []
+        # the attacker sends the CURRENT tx (as an EOA: caller == origin)
+        # and ends up richer than they started. Earlier txs stay
+        # unconstrained — the contract may legitimately have been funded at
+        # creation (reference ether_thief.py:65-72; constraining every tx's
+        # value to 0 would rule out payable constructors like flag_array's).
         world_state = state.world_state
-        for tx in world_state.transaction_sequence:
-            if not isinstance(tx.caller, int) and tx.caller.symbolic:
-                constraints.append(tx.caller == ACTORS.attacker)
-            # exploit must not rely on the attacker seeding the contract
-            if tx.call_value is not None and tx.call_value.symbolic:
-                constraints.append(tx.call_value == 0)
-        constraints.append(
+        current_tx = state.current_transaction
+        constraints = [
             UGT(
                 world_state.balances[ACTORS.attacker],
                 world_state.starting_balances[ACTORS.attacker],
-            )
-        )
+            ),
+            state.environment.sender == ACTORS.attacker,
+            current_tx.caller == current_tx.origin,
+        ]
 
         try:
             get_model(

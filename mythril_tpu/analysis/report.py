@@ -44,6 +44,12 @@ class Issue:
         self.transaction_sequence = transaction_sequence
         if isinstance(bytecode, bytes):
             self.bytecode = bytecode.hex()
+        elif isinstance(bytecode, (tuple, list)):
+            # code with deploy-time-patched symbolic bytes: hash/report the
+            # concrete projection
+            from mythril_tpu.disasm.disassembly import _concrete_projection
+
+            self.bytecode = _concrete_projection(bytecode).hex()
         else:
             self.bytecode = str(bytecode or "")
         try:
@@ -122,7 +128,12 @@ class Report:
         self.execution_info = execution_info or []
 
     def append_issue(self, issue: Issue) -> None:
-        key = f"{issue.contract}-{issue.address}-{issue.swc_id}-{issue.title}"
+        # function is part of the key: distinct functions can share a
+        # revert/panic block address (reference report.py:302-309)
+        key = (
+            f"{issue.contract}-{issue.function}-{issue.address}-"
+            f"{issue.swc_id}-{issue.title}"
+        )
         self.issues[key] = issue
 
     def sorted_issues(self) -> List[Issue]:

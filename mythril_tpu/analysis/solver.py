@@ -77,8 +77,10 @@ def _get_concrete_transaction(model, transaction: BaseTransaction) -> Dict:
     caller = f"0x{model.eval_int(transaction.caller):040x}"
     value = hex(model.eval_int(transaction.call_value))
     if isinstance(transaction, ContractCreationTransaction):
+        from mythril_tpu.disasm.disassembly import _concrete_projection
+
         address = ""
-        input_data = transaction.code.bytecode.hex()
+        input_data = _concrete_projection(transaction.code.bytecode).hex()
     else:
         callee = transaction.callee_account.address
         address = f"0x{model.eval_int(callee):040x}"

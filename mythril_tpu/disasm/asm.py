@@ -15,20 +15,31 @@ class Instr(NamedTuple):
     address: int          # byte offset in the code
     opcode: str           # mnemonic, e.g. "PUSH2"
     byte: int             # raw opcode byte
-    argument: Optional[bytes]  # PUSH operand, else None
+    # PUSH operand: bytes when fully concrete, tuple of int/BitVec(8) when
+    # the code carries symbolic bytes (immutables patched at deploy time),
+    # else None
+    argument: Optional[object]
 
     @property
     def argument_int(self) -> Optional[int]:
-        return int.from_bytes(self.argument, "big") if self.argument is not None else None
+        if self.argument is None:
+            return None
+        if isinstance(self.argument, bytes):
+            return int.from_bytes(self.argument, "big")
+        return None  # symbolic operand
 
     def to_easm(self) -> str:
-        if self.argument is not None:
+        if isinstance(self.argument, bytes):
             return f"{self.address} {self.opcode} 0x{self.argument.hex()}"
+        if self.argument is not None:
+            return f"{self.address} {self.opcode} <symbolic>"
         return f"{self.address} {self.opcode}"
 
 
-def strip_metadata(code: bytes) -> bytes:
+def strip_metadata(code):
     """Drop the CBOR metadata trailer solc appends (…a264…0033 / …a165…)."""
+    if not isinstance(code, bytes):
+        return code  # symbolic code: trailer scan needs concrete bytes
     if len(code) >= 2:
         trailer_len = int.from_bytes(code[-2:], "big")
         if 0 < trailer_len <= len(code) - 2:
@@ -41,19 +52,32 @@ def strip_metadata(code: bytes) -> bytes:
     return code
 
 
-def disassemble(code: bytes) -> List[Instr]:
-    """Linear sweep; PUSH operands are consumed (truncated operand is padded)."""
+def disassemble(code) -> List[Instr]:
+    """Linear sweep; PUSH operands are consumed (truncated operand is padded).
+
+    `code` is bytes, or a sequence of int/BitVec(8) entries when deploy-time
+    patching left symbolic bytes in the runtime code (solidity immutables —
+    reference asm.py:109-141 threads tuples the same way). A symbolic byte
+    in an *opcode* position disassembles as INVALID; symbolic bytes only
+    ever appear in PUSH operands in practice."""
     out: List[Instr] = []
     pc = 0
     length = len(code)
+    symbolic_code = not isinstance(code, (bytes, bytearray))
     while pc < length:
         byte = code[pc]
+        if not isinstance(byte, int):
+            out.append(Instr(pc, "INVALID", 0xFE, None))
+            pc += 1
+            continue
         name = opcodes.name_of(byte)
         width = opcodes.push_width(name)
         if width:
-            operand = code[pc + 1 : pc + 1 + width]
+            operand = tuple(code[pc + 1 : pc + 1 + width])
             if len(operand) < width:
-                operand = operand + b"\x00" * (width - len(operand))
+                operand = operand + (0,) * (width - len(operand))
+            if not symbolic_code or all(isinstance(b, int) for b in operand):
+                operand = bytes(operand)
             out.append(Instr(pc, name, byte, operand))
             pc += 1 + width
         else:

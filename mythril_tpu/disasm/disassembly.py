@@ -12,7 +12,7 @@ from mythril_tpu.disasm.asm import Instr, disassemble, instrs_to_easm, strip_met
 from mythril_tpu.utils.keccak import keccak256
 
 
-def _normalize(code) -> bytes:
+def _normalize(code):
     if isinstance(code, bytes):
         return code
     if isinstance(code, bytearray):
@@ -22,12 +22,25 @@ def _normalize(code) -> bytes:
         if text.startswith("0x"):
             text = text[2:]
         return bytes.fromhex(text) if text else b""
+    if isinstance(code, (tuple, list)):
+        # deploy-time-patched code with symbolic bytes (immutables); keep
+        # symbolic entries, collapse to bytes when fully concrete
+        if all(isinstance(b, int) for b in code):
+            return bytes(code)
+        return tuple(code)
     raise TypeError(f"unsupported code type {type(code)!r}")
+
+
+def _concrete_projection(bytecode) -> bytes:
+    """Concrete view for hashing/reporting: symbolic bytes read as 0x00."""
+    if isinstance(bytecode, bytes):
+        return bytecode
+    return bytes(b if isinstance(b, int) else 0 for b in bytecode)
 
 
 class Disassembly:
     def __init__(self, code, enable_online_lookup: bool = False):
-        self.bytecode: bytes = _normalize(code)
+        self.bytecode = _normalize(code)
         # the CBOR metadata trailer is data, not code: sweep only the stripped
         # region (reference asm.py:119-122 trims the swarm-hash trailer too)
         self.instruction_list: List[Instr] = disassemble(strip_metadata(self.bytecode))
@@ -43,7 +56,7 @@ class Disassembly:
         )
         # parity with reference func_hashes/function_name_to_address fields
         self.func_hashes: List[str] = list(self.function_entries)
-        self.bytecode_hash: bytes = keccak256(self.bytecode)
+        self.bytecode_hash: bytes = keccak256(_concrete_projection(self.bytecode))
 
     def __len__(self) -> int:
         return len(self.bytecode)
@@ -74,7 +87,8 @@ def _find_function_entries(instrs: List[Instr]) -> Dict[str, int]:
     """
     entries: Dict[str, int] = {}
     for i, ins in enumerate(instrs):
-        if ins.opcode != "PUSH4" or ins.argument is None:
+        # symbolic (tuple) operands can't name a selector
+        if ins.opcode != "PUSH4" or not isinstance(ins.argument, bytes):
             continue
         window = instrs[i + 1 : i + 5]
         names = [w.opcode for w in window]

@@ -112,9 +112,14 @@ def _call_family(global_state: GlobalState, op_name: str):
             global_state, to_concrete, call_data_bytes, out_offset, out_size
         )
 
+    # only use accounts we actually know about — materializing an empty
+    # account here would make a later EXTCODESIZE concretely 0, where the
+    # reference models unknown-address code as symbolic absent on-chain
+    # data (reference world_state.py accounts_exist_or_load raises without
+    # a dynamic loader and callers go symbolic)
     callee_account = None
     if to_concrete is not None:
-        callee_account = world_state.accounts_exist_or_load(to_concrete)
+        callee_account = world_state.accounts.get(to_concrete)
 
     if (
         callee_account is None

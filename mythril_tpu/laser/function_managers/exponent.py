@@ -23,6 +23,28 @@ class ExponentFunctionManager:
         if not base.symbolic and not exponent.symbolic:
             value = pow(base.concrete_value, exponent.concrete_value, 2 ** 256)
             return symbol_factory.BitVecVal(value, 256), Bool.value(True)
+        if not base.symbolic and base.concrete_value > 1 and (
+            base.concrete_value & (base.concrete_value - 1)
+        ) == 0:
+            # power-of-two base: (2^k)^e == 1 << (k*e) exactly, including
+            # the wrap to 0 once k*e >= 256 — guard only against the k*e
+            # multiply itself wrapping. Solc emits exp(0x100, shift) for
+            # packed-storage access; keeping this a shift instead of an
+            # uninterpreted function lets div/mod by it reduce to shifts
+            # instead of a ~400k-gate restoring divider.
+            k = base.concrete_value.bit_length() - 1
+            one = symbol_factory.BitVecVal(1, 256)
+            from mythril_tpu.smt import If as _If, ULE
+
+            # guard folded INTO the shift amount (shl saturates to 0 at
+            # >= 256) so the result stays a pure `1 << s` term that
+            # div/mod-by-power-of-two rewrites can see through
+            amount = _If(
+                ULE(exponent, symbol_factory.BitVecVal(256, 256)),
+                exponent * symbol_factory.BitVecVal(k, 256),
+                symbol_factory.BitVecVal(256, 256),
+            )
+            return one << amount, Bool.value(True)
         power = self.exponentiation(base, exponent)
         if not base.symbolic and base.concrete_value in (2, 10, 256):
             base_value = base.concrete_value

@@ -136,7 +136,19 @@ def advance(global_state: GlobalState) -> List[GlobalState]:
 
 def _push(global_state: GlobalState, instr) -> List[GlobalState]:
     value = instr.argument_int if instr.argument is not None else 0
-    global_state.mstate.stack.append(bv(value))
+    if value is None:
+        # symbolic operand (deploy-time-patched immutable): concat the
+        # byte entries big-endian (reference instructions.py push_ tuple arm)
+        parts = [
+            symbol_factory.BitVecVal(b, 8) if isinstance(b, int) else b
+            for b in instr.argument
+        ]
+        word = Concat(parts) if len(parts) > 1 else parts[0]
+        if word.size < 256:
+            word = Concat([symbol_factory.BitVecVal(0, 256 - word.size), word])
+        global_state.mstate.stack.append(simplify(word))
+    else:
+        global_state.mstate.stack.append(bv(value))
     width = len(instr.argument) if instr.argument is not None else 0
     global_state.mstate.pc += 1 + width
     return [global_state]
@@ -883,10 +895,13 @@ def jumpi_(global_state):
     negated_condition = simplify(condition == bv(0))
     successors = []
 
-    # fall-through side
+    # fall-through side. Depth counts branch decisions, not instructions —
+    # max_depth bounds the number of JUMPIs on a path (reference
+    # instructions.py:1636,1661 increments depth only here).
     if not is_false(negated_condition):
         fallthrough = global_state.clone()
         fallthrough.mstate.pc += 1
+        fallthrough.mstate.depth += 1
         if not is_true(negated_condition):
             fallthrough.world_state.constraints.append(negated_condition)
         successors.append(fallthrough)
@@ -896,6 +911,7 @@ def jumpi_(global_state):
         if not is_false(branch_condition):
             jump_state = global_state  # reuse the original for the taken side
             jump_state.mstate.pc = dest_c
+            jump_state.mstate.depth += 1
             if not is_true(branch_condition):
                 jump_state.world_state.constraints.append(branch_condition)
             successors.append(jump_state)

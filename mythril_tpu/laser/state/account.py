@@ -115,7 +115,9 @@ class Account:
 
     @property
     def serialised_code(self) -> str:
-        return self.code.bytecode.hex()
+        from mythril_tpu.disasm.disassembly import _concrete_projection
+
+        return _concrete_projection(self.code.bytecode).hex()
 
     def clone(self, balances=None) -> "Account":
         dup = Account.__new__(Account)

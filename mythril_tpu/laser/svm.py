@@ -358,7 +358,6 @@ class LaserEVM:
             )
 
         self._record_state(global_state, instr)
-        global_state.mstate.depth += 1
 
         try:
             for hook in self.pre_hooks[op_name]:
@@ -469,13 +468,20 @@ class LaserEVM:
         return_data = transaction.return_data
         if signal.revert or return_data is None:
             return
-        raw = bytearray()
+        raw = []
+        symbolic = False
         for byte in return_data.return_data:
             value = byte if isinstance(byte, int) else concrete_or_none(byte)
             if value is None:
-                return  # symbolic runtime code: leave account codeless
-            raw.append(value)
-        transaction.callee_account.code = Disassembly(bytes(raw))
+                # deploy-time-patched byte (solidity immutable): keep the
+                # symbolic expression in the installed code (reference
+                # transaction_models.py:283-290 assigns the raw tuple)
+                raw.append(byte)
+                symbolic = True
+            else:
+                raw.append(value)
+        code = tuple(raw) if symbolic else bytes(raw)
+        transaction.callee_account.code = Disassembly(code)
 
     def _end_message_call(
         self,
@@ -601,7 +607,11 @@ class LaserEVM:
         node.states.append(_StateSnapshot(global_state, instr))
 
     def manage_cfg(self, op_code: Optional[str], new_states: List[GlobalState]):
-        if op_code is None or not self.requires_statespace:
+        # NOT gated on requires_statespace: function-entry naming rides the
+        # CFG nodes, so they must exist even when states aren't recorded
+        # (reference svm.py:581 builds nodes unconditionally; only state
+        # recording inside nodes is statespace-gated)
+        if op_code is None:
             return
         if op_code in ("JUMP", "JUMPI"):
             for state in new_states:

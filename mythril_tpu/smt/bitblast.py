@@ -139,6 +139,9 @@ class Blaster:
 
     def cnf(self, assertion_terms: List[Term], defined_lits: List[int] = ()):
         roots = [self._bool(t) for t in assertion_terms]
+        # kept for the device circuit-SLS path (tpu/circuit.py), which
+        # searches over AIG inputs instead of CNF variables
+        self.last_roots = roots
         return self.aig.to_cnf(roots, defined_lits)
 
     # -- bool lowering ------------------------------------------------------

@@ -50,6 +50,102 @@ def _raw(constraint) -> Term:
     return constraint.raw if isinstance(constraint, Expression) else constraint
 
 
+def _substitute(roots: List[Term], mapping: Dict[str, Term]) -> List[Term]:
+    """Replace sym leaves per `mapping` (name -> term), rebuilding bottom-up
+    through the shared smart constructors so folding re-fires."""
+    cache: Dict[int, Term] = {}
+    for node in terms.walk_terms(roots):
+        if node.op == "sym":
+            replacement = mapping.get(node.params[0])
+            cache[id(node)] = (
+                replacement
+                if replacement is not None and replacement.sort == node.sort
+                else node
+            )
+            continue
+        if not node.children:
+            cache[id(node)] = node
+            continue
+        new_children = [cache[id(c)] for c in node.children]
+        if all(a is b for a, b in zip(new_children, node.children)):
+            cache[id(node)] = node
+        else:
+            cache[id(node)] = terms.rebuild(node, new_children)
+    return [cache[id(r)] for r in roots]
+
+
+def _extract_binding(term: Term, taken) -> Optional[Tuple[str, Term]]:
+    """If `term` asserts sym == rhs (or a bool unit), return the binding."""
+    if term.op == "sym" and term.sort == BOOL:
+        return term.params[0], terms.TRUE
+    if term.op == "not" and term.children[0].op == "sym" \
+            and term.children[0].sort == BOOL:
+        return term.children[0].params[0], terms.FALSE
+    if term.op != "eq":
+        return None
+    lhs, rhs = term.children
+    if not (isinstance(lhs.sort, int) or lhs.sort == BOOL):
+        return None  # array equality: not handled here
+    # prefer binding to a constant; otherwise either side's symbol
+    for sym_side, value_side in ((lhs, rhs), (rhs, lhs)):
+        if sym_side.op != "sym" or sym_side.params[0] in taken:
+            continue
+        name = sym_side.params[0]
+        if (name, sym_side.sort) in terms.free_symbols([value_side]):
+            continue  # occurs check: x == f(x) is not a definition
+        return name, value_side
+    return None
+
+
+def propagate_equalities(
+    asserted: List[Term], max_rounds: int = 8
+) -> Tuple[List[Term], List[Tuple[str, Term]], bool]:
+    """Equality/constant propagation over the assertion set (pre-blast).
+
+    Asserted `sym == rhs` definitions are substituted through every other
+    constraint and dropped; repeated to fixpoint. EVM path constraints pin
+    many symbols (selector bytes, caller, callvalue), and substituting them
+    collapses ite ladders and whole arithmetic cones before the expensive
+    bit-blast — the word-level preprocessing role z3 plays for the
+    reference. Returns (residual constraints, substitutions in insertion
+    order, trivially_unsat). Model reconstruction re-derives substituted
+    symbols by evaluating their definitions in reverse insertion order."""
+    substitutions: List[Tuple[str, Term]] = []
+    taken = set()
+    work = list(asserted)
+    for _ in range(max_rounds):
+        found: Dict[str, Term] = {}
+        remaining: List[Term] = []
+        for term in work:
+            if found:
+                # apply this round's earlier bindings before inspecting, so
+                # `x == 5; y == x + 1` resolves in one round
+                term = terms.simplify_expr(_substitute([term], found)[0])
+            if term.is_const:
+                if term.value is False:
+                    return [], substitutions, True
+                continue
+            binding = _extract_binding(term, taken)
+            if binding is not None:
+                name, rhs = binding
+                taken.add(name)
+                found[name] = rhs
+                substitutions.append((name, rhs))
+                continue
+            remaining.append(term)
+        if not found:
+            return remaining, substitutions, False
+        work = []
+        for term in _substitute(remaining, found):
+            term = terms.simplify_expr(term)
+            if term.is_const:
+                if term.value is False:
+                    return [], substitutions, True
+                continue
+            work.append(term)
+    return work, substitutions, False
+
+
 class _Lowering:
     """Rewrites a set of bool terms into pure QF_BV + side constraints."""
 
@@ -163,7 +259,8 @@ class _Prepared:
     """Lowered + blasted problem state shared across assumption probes."""
 
     __slots__ = ("trivial", "original", "lowering", "blaster",
-                 "num_vars", "clauses", "objective_bits", "last_bits")
+                 "num_vars", "clauses", "objective_bits", "last_bits",
+                 "substitutions")
 
     def __init__(self):
         self.trivial: Optional[str] = None
@@ -174,6 +271,8 @@ class _Prepared:
         self.clauses: List = []
         self.objective_bits: List[List[int]] = []
         self.last_bits: Optional[List[bool]] = None
+        # (name, definition) pairs eliminated by propagate_equalities
+        self.substitutions: List[Tuple[str, Term]] = []
 
 
 class Solver:
@@ -223,9 +322,31 @@ class Solver:
             asserted.append(term)
         prep.original = asserted
 
+        # pre-blast word-level preprocessing: substitute asserted
+        # definitions (sym == rhs) through the set before any lowering
+        asserted_residual, prep.substitutions, unsat = propagate_equalities(
+            asserted
+        )
+        if unsat:
+            prep.trivial = UNSAT
+            return prep
+        # objectives must see the same substitution; iterate because later
+        # bindings may appear inside earlier definitions
+        if objectives and prep.substitutions:
+            mapping = dict(prep.substitutions)
+            objectives = list(objectives)
+            for _ in range(len(prep.substitutions)):
+                new_objectives = [
+                    terms.simplify_expr(t)
+                    for t in _substitute(objectives, mapping)
+                ]
+                if all(a is b for a, b in zip(new_objectives, objectives)):
+                    break
+                objectives = new_objectives
+
         lowering = _Lowering()
         try:
-            lowered = [lowering.lower(t) for t in asserted]
+            lowered = [lowering.lower(t) for t in asserted_residual]
             lowered_objectives = [lowering.lower(o) for o in objectives]
         except NotImplementedError:
             prep.trivial = UNKNOWN
@@ -253,6 +374,9 @@ class Solver:
 
     def _solve_prepared(self, prep: "_Prepared",
                         assumptions: List[int] = ()) -> str:
+        aig_roots = None
+        if prep.blaster is not None and not assumptions:
+            aig_roots = (prep.blaster.aig, prep.blaster.last_roots)
         status, bits = sat_backend.solve_cnf(
             prep.num_vars,
             prep.clauses,
@@ -260,12 +384,11 @@ class Solver:
             timeout_seconds=self.timeout or 0.0,
             conflict_budget=self.conflict_budget,
             allow_device=self.allow_device,
+            aig_roots=aig_roots,
         )
         if status == SAT:
             prep.last_bits = bits
-            self._model = self._reconstruct(
-                prep.blaster, bits, prep.lowering, prep.original
-            )
+            self._model = self._reconstruct(prep, bits)
         return status
 
     def _check(self, extra: List[Term]) -> str:
@@ -273,12 +396,35 @@ class Solver:
         prep = self._prepare(extra)
         if prep.trivial is not None:
             if prep.trivial == SAT:
-                self._model = Model({})
+                self._model = self._trivial_model(prep)
             return prep.trivial
         return self._solve_prepared(prep)
 
-    def _reconstruct(self, blaster: Blaster, bits: List[bool],
-                     lowering: _Lowering, original: List[Term]) -> Model:
+    @staticmethod
+    def _resolve_substitutions(assignment: Dict, prep: "_Prepared") -> None:
+        """Re-derive symbols eliminated by propagate_equalities.
+
+        Reverse insertion order works because each definition was fully
+        substituted w.r.t. earlier bindings when recorded — it can only
+        reference later-bound or never-bound symbols."""
+        for name, definition in reversed(prep.substitutions):
+            assignment[name] = evaluate(definition, assignment)
+
+    def _trivial_model(self, prep: "_Prepared") -> Model:
+        """All constraints eliminated by preprocessing: the model is just
+        the substituted definitions (empty only when none were made)."""
+        assignment: Dict = {}
+        self._resolve_substitutions(assignment, prep)
+        model = Model(assignment)
+        for term in prep.original:
+            if evaluate(term, model.assignment) is not True:
+                raise SolverInternalError(
+                    f"model validation failed on {terms.term_to_str(term)}"
+                )
+        return model
+
+    def _reconstruct(self, prep: "_Prepared", bits: List[bool]) -> Model:
+        blaster, lowering = prep.blaster, prep.lowering
         assignment: Dict = {}
         for name, var_list in blaster.bv_symbol_vars.items():
             value = 0
@@ -302,12 +448,18 @@ class Solver:
                 key = tuple(evaluate(a, assignment) for a in args_terms)
                 table[key] = assignment.get(sym_term.params[0], 0)
             assignment[func_name] = (0, table)
+        # symbols eliminated pre-blast come back via their definitions.
+        # AFTER the array/UF tables: a definition like x == storage[0]
+        # needs the rebuilt table, while the recorded array-read index
+        # terms were lowered post-substitution and so never reference an
+        # eliminated symbol — this order has no cycle.
+        self._resolve_substitutions(assignment, prep)
         # drop internal fresh symbols from the visible model
         visible = {k: v for k, v in assignment.items()
                    if not (isinstance(k, str) and k.startswith("!"))}
         model = Model(visible)
         # soundness net: the model must satisfy the ORIGINAL constraints
-        for term in original:
+        for term in prep.original:
             if evaluate(term, model.assignment) is not True:
                 raise SolverInternalError(
                     f"model validation failed on {terms.term_to_str(term)}"
@@ -343,7 +495,7 @@ class Optimize(Solver):
         prep = self._prepare(extra, [obj for _, obj in self._objectives])
         if prep.trivial is not None:
             if prep.trivial == SAT:
-                self._model = Model({})
+                self._model = self._trivial_model(prep)
             return prep.trivial
         status = self._solve_prepared(prep)
         if status != SAT:

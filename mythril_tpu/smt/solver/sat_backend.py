@@ -79,6 +79,7 @@ def solve_cnf(
     timeout_seconds: float = 0.0,
     conflict_budget: int = 0,
     allow_device: bool = True,
+    aig_roots=None,
 ) -> Tuple[str, Optional[List[bool]]]:
     """Solve CNF with DIMACS-signed literals.
 
@@ -113,7 +114,8 @@ def solve_cnf(
             device_budget = min(2.0, timeout_seconds * 0.4) \
                 if timeout_seconds else 2.0
             bits = get_device_backend().try_solve(
-                num_vars, clauses, assumptions, budget_seconds=device_budget)
+                num_vars, clauses, assumptions, budget_seconds=device_budget,
+                aig_roots=aig_roots)
             if bits is not None:
                 return SAT, bits
         except Exception as error:

@@ -172,6 +172,8 @@ def bv_binop(op: str, a: Term, b: Term) -> Term:
                 return a
             if v == 1:
                 return b
+            if (v & (v - 1)) == 0:  # 2^k: shift beats a shift-add multiplier
+                return bv_binop("bvshl", b, bv_val(v.bit_length() - 1, size))
         if op == "bvand":
             if v == 0:
                 return a
@@ -192,11 +194,52 @@ def bv_binop(op: str, a: Term, b: Term) -> Term:
             return a
         if op in ("bvshl", "bvlshr") and v >= size:
             return bv_val(0, size)
+        # power-of-two strength reduction: a restoring-division circuit is
+        # ~1500 gates/bit when blasted (solc emits div/mod-by-32 for packed
+        # storage and div-by-2^224 for selector extraction all the time)
+        if v > 1 and (v & (v - 1)) == 0:
+            shift = v.bit_length() - 1
+            if op == "bvudiv":
+                return bv_binop("bvlshr", a, bv_val(shift, size))
+            if op == "bvurem":
+                return bv_binop("bvand", a, bv_val(v - 1, size))
     if op == "bvsub" and a == b:
         return bv_val(0, size)
     if op == "bvxor" and a == b:
         return bv_val(0, size)
+    # symbolic power-of-two divisor/factor: `1 << s` is 2^s (or 0 once
+    # s >= size, which matches EVM div-by-zero -> 0 and shl saturation),
+    # so div/mul reduce to shifts and rem to a mask — the packed-storage
+    # access pattern solc emits via EXP(0x100, ...)
+    shift = _as_one_shl(b)
+    if shift is not None:
+        if op == "bvudiv":
+            return bv_binop("bvlshr", a, shift)
+        if op == "bvmul":
+            return bv_binop("bvshl", a, shift)
+        if op == "bvurem":
+            # b == 0 (s >= size) must give a % 0 == 0, not the full mask
+            return ite(
+                eq(b, bv_val(0, size)),
+                bv_val(0, size),
+                bv_binop("bvand", a, bv_binop("bvsub", b, bv_val(1, size))),
+            )
+    if op == "bvmul":
+        shift = _as_one_shl(a)
+        if shift is not None:
+            return bv_binop("bvshl", b, shift)
     return Term(op, (a, b), (), size)
+
+
+def _as_one_shl(t: Term):
+    """Return s when t is literally `1 << s`, else None."""
+    if (
+        t.op == "bvshl"
+        and t.children[0].is_const
+        and t.children[0].value == 1
+    ):
+        return t.children[1]
+    return None
 
 
 def bv_not(a: Term) -> Term:

@@ -170,7 +170,9 @@ def get_models_batch(
         prep = solver._prepare([])
         if prep.trivial is not None:
             if prep.trivial == SAT:
-                model = Model({})
+                # preprocessing may have eliminated every constraint via
+                # substitutions — the model must still carry those values
+                model = solver._trivial_model(prep)
                 results[idx] = ("sat", model)
                 if key is not None:
                     _store_result(key, model)
@@ -184,13 +186,11 @@ def get_models_batch(
         pending.append((idx, key, solver, prep))
 
     if pending and args.solver_backend == "tpu":
-        from mythril_tpu.tpu import pack
-
         eligible = []
         ineligible = []
         for entry in pending:
             prep = entry[3]
-            if pack.fits_device(prep.num_vars, prep.clauses) and not any(
+            if prep.blaster is not None and not any(
                 len(c) == 0 for c in prep.clauses
             ):
                 eligible.append(entry)
@@ -201,8 +201,14 @@ def get_models_batch(
             from mythril_tpu.tpu.backend import get_device_backend
 
             backend = get_device_backend()
-            problems = [(p.num_vars, p.clauses) for _, _, _, p in eligible]
-            bits_list = backend.try_solve_batch(
+            # the justification-based circuit kernel is the production
+            # device path: it searches over AIG inputs, so blasted
+            # arithmetic actually solves (tpu/circuit.py)
+            problems = [
+                (p.num_vars, p.clauses, (p.blaster.aig, p.blaster.last_roots))
+                for _, _, _, p in eligible
+            ]
+            bits_list = backend.try_solve_batch_circuit(
                 problems, budget_seconds=min(4.0, timeout_s))
         except Exception as error:
             import logging
@@ -217,8 +223,7 @@ def get_models_batch(
                 still_pending.append((idx, key, solver, prep))
                 continue
             try:
-                model = solver._reconstruct(
-                    prep.blaster, bits, prep.lowering, prep.original)
+                model = solver._reconstruct(prep, bits)
             except Exception:
                 still_pending.append((idx, key, solver, prep))
                 continue

@@ -99,8 +99,20 @@ class DeviceSolverBackend:
         clauses: Sequence[Tuple[int, ...]],
         assumptions: Sequence[int] = (),
         budget_seconds: float = 2.0,
+        aig_roots: Optional[Tuple] = None,
     ) -> Optional[List[bool]]:
-        """Search for a model on device; None if not found in budget."""
+        """Search for a model on device; None if not found in budget.
+
+        When the caller provides the AIG (`aig_roots=(aig, root_lits)`) and
+        there are no assumptions, the justification-based circuit kernel is
+        used — it searches over circuit inputs only and actually solves
+        blasted arithmetic (tpu/circuit.py); the CNF local-search kernels
+        remain the fallback for assumption probes and bare-CNF callers."""
+        if aig_roots is not None and not assumptions:
+            bits = self._try_solve_circuit(
+                num_vars, clauses, aig_roots, budget_seconds)
+            if bits is not None:
+                return bits
         full = [tuple(c) for c in clauses] + [(a,) for a in assumptions]
         if num_vars == 0 or not pack.fits_device(num_vars, full):
             return None
@@ -171,6 +183,160 @@ class DeviceSolverBackend:
         self.fallbacks += 1
         self.device_seconds += time.monotonic() - start
         return None
+
+    # -- justification-based circuit path (the production device solver) ----
+
+    CIRCUIT_STEPS = 192
+
+    def _try_solve_circuit(self, num_vars, clauses, aig_roots,
+                           budget_seconds) -> Optional[List[bool]]:
+        """Single-query circuit solve; validates against the CNF on host."""
+        results = self.try_solve_batch_circuit(
+            [(num_vars, clauses, aig_roots)], budget_seconds=budget_seconds
+        )
+        return results[0]
+
+    def try_solve_batch_circuit(
+        self,
+        problems: Sequence[Tuple[int, Sequence, Tuple]],
+        budget_seconds: float = 4.0,
+        size_caps: Optional[Tuple[int, int, int]] = None,
+    ) -> List[Optional[List[bool]]]:
+        """Solve many blasted queries with the circuit-SLS kernel in one
+        vmapped fan-out. `problems` entries are (num_vars, clauses,
+        (aig, root_lits)). Returns per-query model bits or None (caller's
+        CDCL settles misses and alone proves UNSAT).
+
+        `size_caps` overrides the platform (level, cell, var) eligibility
+        caps — tests exercise large circuits on the CPU platform this way."""
+        from mythril_tpu.tpu import circuit
+
+        results: List[Optional[List[bool]]] = [None] * len(problems)
+        try:
+            jax, _ = self._modules()
+        except Exception:
+            return results
+        if size_caps is not None:
+            level_cap, cell_cap, v1_cap = size_caps
+        elif jax.default_backend() == "cpu":
+            # the CPU platform pays full jit cost with none of the device
+            # speed — keep production circuits tiny there so analyze-level
+            # budgets (create timeout) survive; the TPU path takes real ones
+            level_cap, cell_cap, v1_cap = 384, 1 << 16, 1 << 12
+        else:
+            level_cap, cell_cap = circuit.MAX_LEVELS, 1 << 22
+            v1_cap = circuit.MAX_VARS
+        packed: List[Tuple[int, int, object]] = []  # (orig idx, num_vars, pc)
+        for qi, (num_vars, clauses, (aig, roots)) in enumerate(problems):
+            if num_vars == 0:
+                continue
+            pc = circuit.PackedCircuit(aig, roots)
+            if (
+                pc.ok
+                and pc.num_levels <= level_cap
+                and pc.num_levels * pc.max_width <= cell_cap
+                and pc.v1 <= v1_cap
+            ):
+                packed.append((qi, num_vars, pc))
+        if not packed:
+            return results
+        start = time.monotonic()
+        deadline = start + budget_seconds
+        self.batch_calls += 1
+        self.batch_queries += len(packed)
+        self._seed += 1
+
+        def _bucket(n):  # pow2 shape buckets amortize jit compiles
+            size = 64
+            while size < n:
+                size *= 2
+            return size
+
+        n_levels = _bucket(max(p.num_levels for _, _, p in packed) or 1)
+        width = _bucket(max(p.max_width for _, _, p in packed))
+        v1 = _bucket(max(p.v1 for _, _, p in packed))
+        n_roots = _bucket(max(p.num_roots for _, _, p in packed))
+        walk_depth = min(n_levels + 4, circuit.MAX_LEVELS)
+
+        q = 1
+        while q < len(packed):
+            q *= 2
+        padded = [
+            p.padded_to(n_levels, width, v1, n_roots) for _, _, p in packed
+        ]
+        # query-axis padding: zero tensors have no live roots, so padding
+        # slots report found at step 0 and stay frozen
+        zero = {
+            k: np.zeros_like(padded[0][k]) for k in circuit.TENSOR_KEYS
+        }
+        padded += [zero] * (q - len(packed))
+        batch = {
+            k: np.stack([entry[k] for entry in padded])
+            for k in circuit.TENSOR_KEYS
+        }
+        tensors = {k: jax.numpy.asarray(v) for k, v in batch.items()}
+        key = jax.random.PRNGKey(self._seed)
+        key, init_key = jax.random.split(key)
+        x = jax.random.bernoulli(
+            init_key, 0.5, (q, self.num_restarts, v1)
+        ).astype(jax.numpy.int32)
+        keys = jax.random.split(key, q)
+
+        # sticky per-slot results: a query solved in round k must keep its
+        # model even if later rounds re-randomize or stop reporting found
+        solved = np.zeros((q,), dtype=bool)
+        best_rows = {}  # slot -> host copy of the satisfying assignment
+        rounds = 0
+        while True:
+            x, found = circuit.run_round_circuit_batch(
+                tensors, x, keys, steps=self.CIRCUIT_STEPS,
+                walk_depth=walk_depth)
+            rounds += 1
+            self.flips += q * self.num_restarts * self.CIRCUIT_STEPS
+            found_host = np.asarray(found)
+            round_solved = found_host.any(axis=1)
+            newly = round_solved & ~solved
+            if newly.any():
+                x_host = np.asarray(x)
+                for slot in np.nonzero(newly)[0]:
+                    row = int(np.argmax(found_host[slot]))
+                    best_rows[int(slot)] = x_host[slot, row].copy()
+            solved |= round_solved
+            if solved.all() or time.monotonic() >= deadline:
+                break
+            keys = jax.vmap(jax.random.fold_in)(
+                keys,
+                jax.numpy.full((q,), rounds, dtype=jax.numpy.uint32),
+            )
+            # re-randomize UNSOLVED queries' stale half for diversification
+            # (solved slots keep their frozen assignments)
+            key, re_key = jax.random.split(key)
+            fresh = jax.random.bernoulli(
+                re_key, 0.5, x.shape).astype(jax.numpy.int32)
+            half = self.num_restarts // 2
+            if half:
+                unsolved = jax.numpy.asarray(
+                    (~solved).astype(np.int32))[:, None, None]
+                x = x.at[:, :half].set(
+                    x[:, :half] * (1 - unsolved)
+                    + fresh[:, :half] * unsolved
+                )
+
+        for slot, (qi, num_vars, p) in enumerate(packed):
+            assignment = best_rows.get(slot)
+            if assignment is None:
+                continue
+            bits = [False] * (num_vars + 1)
+            for var in range(1, min(num_vars, p.num_vars) + 1):
+                bits[var] = bool(assignment[var])
+            if self._honors(bits, problems[qi][1]):
+                results[qi] = bits
+                self.batch_sat += 1
+                self.sat_found += 1
+            else:
+                log.warning("circuit model failed host clause check")
+        self.device_seconds += time.monotonic() - start
+        return results
 
     def try_solve_batch(
         self,

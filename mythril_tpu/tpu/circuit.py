@@ -1,0 +1,301 @@
+"""Justification-based circuit SLS — the device solver that actually solves
+blasted arithmetic.
+
+Plain CNF local search (WalkSAT) cannot crack Tseitin-encoded adder and
+comparator chains: almost all CNF variables are gate outputs whose values
+are *determined* by the circuit inputs, and random flips spend the whole
+budget repairing self-inflicted gate inconsistencies (round-2 verdict:
+0/7 satisfiable 64-bit bench queries solved).
+
+This kernel searches over the AIG *inputs* only:
+
+  1. forward-simulate the levelized AIG — every gate is consistent by
+     construction, so the ONLY possible violations are the asserted root
+     literals;
+  2. pick a violated root uniformly at random;
+  3. walk backward through its justification frontier: at an AND gate
+     whose output must be 1, descend into a child literal that is
+     currently 0; at a gate whose output must be 0, descend into a
+     currently-true child; stop when the subgoal is already justified or
+     an input variable is reached;
+  4. flip that input to the wanted value; resimulate.
+
+This is the classic BC-SLS / justification-frontier scheme, and it maps
+cleanly onto the TPU: simulation is a lax.scan of gather→and→scatter
+steps over levels (static shapes), the walk is a bounded scan of scalar
+gathers, and restarts/queries vectorize with vmap. A satisfying input
+assignment found here satisfies the WHOLE CNF after one simulation pass.
+
+Shapes: x is [R, V1] int32 in {0,1} (var 0 pinned to 0 = constant FALSE;
+literal value = x[var] ^ neg). Level tensors [L, G]; per-var gate tables
+[V1]. Padding gates read and write var 0 with value 0 — a no-op.
+"""
+
+import functools
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+# compile-time caps: circuits past these take the CDCL path
+MAX_LEVELS = 4096
+MAX_VARS = 1 << 18
+
+
+class PackedCircuit:
+    """Levelized AIG cone of the asserted roots, as dense numpy tensors.
+
+    `ok` is False when the roots are trivially unsatisfiable or the
+    circuit exceeds the device caps."""
+
+    __slots__ = ("num_vars", "v1", "num_levels", "max_width",
+                 "out_idx", "a_var", "a_neg", "b_var", "b_neg",
+                 "ga_var", "ga_neg", "gb_var", "gb_neg", "is_gate",
+                 "root_var", "root_neg", "root_mask", "ok", "num_roots")
+
+    def __init__(self, aig, roots: List[int]):
+        self.ok = False
+        self.num_vars = aig.num_vars
+        gate_index = {v: i for i, v in enumerate(aig.gate_vars)}
+
+        live_roots = []
+        for lit in roots:
+            if lit == 1:  # constant TRUE root: vacuous
+                continue
+            if lit == 0:  # constant FALSE root: unsatisfiable
+                return
+            live_roots.append(lit)
+
+        # cone of influence + levelization (iterative)
+        level = {0: 0}
+        stack = [lit >> 1 for lit in live_roots]
+        while stack:
+            var = stack[-1]
+            if var in level:
+                stack.pop()
+                continue
+            gi = gate_index.get(var)
+            if gi is None:
+                level[var] = 0  # input
+                stack.pop()
+                continue
+            lhs, rhs = aig.gates[gi]
+            children = (lhs >> 1, rhs >> 1)
+            missing = [c for c in children if c not in level]
+            if missing:
+                stack.extend(missing)
+            else:
+                level[var] = 1 + max(level[c] for c in children)
+                stack.pop()
+
+        num_levels = max(level.values(), default=0)
+        if num_levels > MAX_LEVELS or aig.num_vars + 1 > MAX_VARS:
+            return
+
+        by_level: List[List[int]] = [[] for _ in range(num_levels + 1)]
+        for var, lv in level.items():
+            if lv > 0:
+                by_level[lv].append(var)
+        max_width = max((len(g) for g in by_level[1:]), default=1) or 1
+
+        v1 = aig.num_vars + 1
+        self.v1 = v1
+        self.num_levels = num_levels
+        self.max_width = max_width
+
+        shape = (max(num_levels, 1), max_width)
+        out_idx = np.zeros(shape, dtype=np.int32)
+        a_var = np.zeros(shape, dtype=np.int32)
+        a_neg = np.zeros(shape, dtype=np.int32)
+        b_var = np.zeros(shape, dtype=np.int32)
+        b_neg = np.zeros(shape, dtype=np.int32)
+        ga_var = np.zeros((v1,), dtype=np.int32)
+        ga_neg = np.zeros_like(ga_var)
+        gb_var = np.zeros_like(ga_var)
+        gb_neg = np.zeros_like(ga_var)
+        is_gate = np.zeros_like(ga_var)
+        for lv in range(1, num_levels + 1):
+            for slot, var in enumerate(by_level[lv]):
+                lhs, rhs = aig.gates[gate_index[var]]
+                out_idx[lv - 1, slot] = var
+                a_var[lv - 1, slot] = lhs >> 1
+                a_neg[lv - 1, slot] = lhs & 1
+                b_var[lv - 1, slot] = rhs >> 1
+                b_neg[lv - 1, slot] = rhs & 1
+                ga_var[var], ga_neg[var] = lhs >> 1, lhs & 1
+                gb_var[var], gb_neg[var] = rhs >> 1, rhs & 1
+                is_gate[var] = 1
+
+        self.out_idx, self.a_var, self.a_neg = out_idx, a_var, a_neg
+        self.b_var, self.b_neg = b_var, b_neg
+        self.ga_var, self.ga_neg = ga_var, ga_neg
+        self.gb_var, self.gb_neg = gb_var, gb_neg
+        self.is_gate = is_gate
+
+        self.num_roots = max(len(live_roots), 1)
+        root_var = np.zeros((self.num_roots,), dtype=np.int32)
+        root_neg = np.zeros_like(root_var)
+        root_mask = np.zeros_like(root_var)
+        for i, lit in enumerate(live_roots):
+            root_var[i] = lit >> 1
+            root_neg[i] = lit & 1
+            root_mask[i] = 1
+        self.root_var, self.root_neg, self.root_mask = (
+            root_var, root_neg, root_mask
+        )
+        self.ok = True
+
+    def padded_to(self, num_levels, max_width, v1, num_roots) -> dict:
+        """Copy tensors into a shared batch shape (for query-axis vmap)."""
+        def pad2(a):
+            out = np.zeros((max(num_levels, 1), max_width), dtype=np.int32)
+            out[: a.shape[0], : a.shape[1]] = a
+            return out
+
+        def pad1(a, n):
+            out = np.zeros((n,), dtype=np.int32)
+            out[: a.shape[0]] = a
+            return out
+
+        return dict(
+            out_idx=pad2(self.out_idx), a_var=pad2(self.a_var),
+            a_neg=pad2(self.a_neg), b_var=pad2(self.b_var),
+            b_neg=pad2(self.b_neg),
+            ga_var=pad1(self.ga_var, v1), ga_neg=pad1(self.ga_neg, v1),
+            gb_var=pad1(self.gb_var, v1), gb_neg=pad1(self.gb_neg, v1),
+            is_gate=pad1(self.is_gate, v1),
+            root_var=pad1(self.root_var, num_roots),
+            root_neg=pad1(self.root_neg, num_roots),
+            root_mask=pad1(self.root_mask, num_roots),
+        )
+
+
+TENSOR_KEYS = ("out_idx", "a_var", "a_neg", "b_var", "b_neg",
+               "ga_var", "ga_neg", "gb_var", "gb_neg", "is_gate",
+               "root_var", "root_neg", "root_mask")
+
+
+def _simulate(x, levels):
+    """Forward-simulate all levels; x [R, V1] int32."""
+    def body(x, level):
+        oi, av_i, an, bv_i, bn = level
+        av = jnp.take(x, av_i, axis=1) ^ an[None, :]
+        bv = jnp.take(x, bv_i, axis=1) ^ bn[None, :]
+        out = av & bv
+
+        def scat(row, vals):
+            return row.at[oi].set(vals)
+
+        return jax.vmap(scat)(x, out), None
+
+    x, _ = lax.scan(body, x, levels)
+    return x
+
+
+def _walk(x, start_var, start_neg, key, tables, depth):
+    """Backward justification walk; returns (var_to_flip, wanted_value).
+
+    `want` is in the VARIABLE domain throughout: the root literal must be
+    TRUE, so the root variable must be 1 ^ root_neg."""
+    ga_var, ga_neg, gb_var, gb_neg, is_gate = tables
+    R = x.shape[0]
+    rows = jnp.arange(R)
+
+    def body(carry, step_key):
+        cur, want, done = carry
+        is_g = (is_gate[cur] == 1) & (~done)
+        av_i, an = ga_var[cur], ga_neg[cur]
+        bv_i, bn = gb_var[cur], gb_neg[cur]
+        av = x[rows, av_i] ^ an
+        bv = x[rows, bv_i] ^ bn
+        gate_val = av & bv
+        justified = gate_val == want
+        coin = jax.random.bernoulli(step_key, 0.5, (R,))
+        # want 1: both child literals must be 1 -> descend into a false one
+        choose_b1 = ((av == 1) & (bv == 0)) | ((av == 0) & (bv == 0) & coin)
+        # want 0: some child literal must become 0 -> descend into a true one
+        choose_b0 = ((av == 0) & (bv == 1)) | ((av == 1) & (bv == 1) & coin)
+        choose_b = jnp.where(want == 1, choose_b1, choose_b0)
+        child_var = jnp.where(choose_b, bv_i, av_i)
+        child_neg = jnp.where(choose_b, bn, an)
+        # desired child LITERAL value equals the desired gate value; the
+        # child VARIABLE value folds in the edge complement
+        child_want = want ^ child_neg
+        step_active = is_g & (~justified)
+        cur = jnp.where(step_active, child_var, cur)
+        want = jnp.where(step_active, child_want, want)
+        done = done | (~is_g) | justified
+        return (cur, want, done), None
+
+    keys = jax.random.split(key, depth)
+    want0 = jnp.ones((R,), dtype=jnp.int32) ^ start_neg
+    # derive from a varying value (not a fresh constant) so varying manual
+    # axes match the carry outputs under shard_map (scan-vma)
+    done0 = start_var < 0
+    (cur, want, _), _ = lax.scan(body, (start_var, want0, done0), keys)
+    return cur, want
+
+
+@functools.partial(jax.jit, static_argnames=("steps", "walk_depth"))
+def run_round_circuit(tensors: dict, x, key, steps: int, walk_depth: int):
+    """Advance R restarts of one circuit by `steps` sim+flip iterations.
+
+    tensors: dict of TENSOR_KEYS arrays. Returns (x, found)."""
+    levels = (tensors["out_idx"], tensors["a_var"], tensors["a_neg"],
+              tensors["b_var"], tensors["b_neg"])
+    tables = (tensors["ga_var"], tensors["ga_neg"],
+              tensors["gb_var"], tensors["gb_neg"], tensors["is_gate"])
+    root_var = tensors["root_var"]
+    root_neg = tensors["root_neg"]
+    root_mask = tensors["root_mask"]
+    R = x.shape[0]
+    rows = jnp.arange(R)
+
+    def step(carry, step_key):
+        x, found = carry
+        x = x.at[:, 0].set(0)
+        x = _simulate(x, levels)
+        root_vals = jnp.take(x, root_var, axis=1) ^ root_neg[None, :]
+        violated = (root_vals == 0) & (root_mask[None, :] == 1)
+        found = found | (violated.sum(axis=1) == 0)
+        k_root, k_walk = jax.random.split(step_key)
+        logits = jnp.where(violated, 0.0, -1e9)
+        root_choice = jax.random.categorical(k_root, logits, axis=1)
+        start_var = root_var[root_choice]
+        start_neg = root_neg[root_choice]
+        flip_var, flip_want = _walk(
+            x, start_var, start_neg, k_walk, tables, walk_depth)
+        new_val = jnp.where(found, x[rows, flip_var], flip_want)
+        x = x.at[rows, flip_var].set(new_val)
+        return (x, found), None
+
+    # derive from x (not a fresh constant): varying manual axes must match
+    # the carry output under shard_map (scan-vma)
+    found0 = jnp.sum(x, axis=1) < -1
+    keys = jax.random.split(key, steps)
+    (x, found), _ = lax.scan(step, (x, found0), keys)
+    # final simulate: returned assignments must be gate-consistent
+    x = x.at[:, 0].set(0)
+    x = _simulate(x, levels)
+    root_vals = jnp.take(x, root_var, axis=1) ^ root_neg[None, :]
+    violated = (root_vals == 0) & (root_mask[None, :] == 1)
+    found = found | (violated.sum(axis=1) == 0)
+    return x, found
+
+
+@functools.partial(jax.jit, static_argnames=("steps", "walk_depth"))
+def run_round_circuit_batch(tensors: dict, x, keys, steps: int,
+                            walk_depth: int):
+    """Query-batched variant: every tensor has a leading Q axis,
+    x is [Q, R, V1], keys [Q, 2]."""
+    return jax.vmap(
+        lambda t, xx, kk: run_round_circuit(
+            t, xx, kk, steps=steps, walk_depth=walk_depth)
+    )(tensors, x, keys)
+
+
+def init_inputs(key, num_restarts: int, v1: int):
+    x = jax.random.bernoulli(key, 0.5, (num_restarts, v1)).astype(jnp.int32)
+    return x.at[:, 0].set(0)

@@ -9,6 +9,15 @@ import sys
 # JAX_PLATFORMS=axon (the real-TPU tunnel); tests must never claim the chip
 # (a wedged grant blocks every later jax process on the machine).
 os.environ["JAX_PLATFORMS"] = "cpu"
+# sitecustomize (axon tunnel) may have imported jax BEFORE this conftest
+# runs, freezing JAX_PLATFORMS=axon into jax.config — override via the
+# config API too, or a wedged TPU tunnel hangs every test that touches jax
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
 # small restart batch: keeps device-solver jit shapes tiny on the CPU
 # platform (hard assignment — ambient env must not win here either)
 os.environ["MYTHRIL_TPU_RESTARTS"] = "16"

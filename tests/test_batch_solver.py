@@ -51,19 +51,19 @@ def test_batch_results_cached():
 
 
 def test_batch_rides_one_device_call(monkeypatch):
-    """N eligible queries -> exactly ONE try_solve_batch fan-out."""
+    """N eligible queries -> exactly ONE circuit-batch fan-out."""
     from mythril_tpu.tpu import backend as backend_mod
 
     args.solver_backend = "tpu"
     device = backend_mod.get_device_backend()
     calls = []
-    real = device.try_solve_batch
+    real = device.try_solve_batch_circuit
 
     def spy(problems, budget_seconds=4.0):
         calls.append(len(problems))
         return real(problems, budget_seconds=budget_seconds)
 
-    monkeypatch.setattr(device, "try_solve_batch", spy)
+    monkeypatch.setattr(device, "try_solve_batch_circuit", spy)
 
     queries = []
     for i in range(6):

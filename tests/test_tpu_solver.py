@@ -75,3 +75,66 @@ def test_solver_backend_flag_routes_word_level_queries():
         assert (av + bv) % (1 << 32) == 1000 and av > 400 and bv > 400
     finally:
         args.solver_backend = "cpu"
+
+
+def _bench_like_query(qi, bits=64):
+    """Same shape as bench.py build_queries: selector + guards + adder."""
+    data = symbol_factory.BitVecSym(f"cq_data_{qi}_{bits}", bits)
+    value = symbol_factory.BitVecSym(f"cq_value_{qi}_{bits}", bits)
+    sender = symbol_factory.BitVecSym(f"cq_sender_{qi}_{bits}", bits)
+    solver = Solver()
+    selector = 0x41C0E1B5 ^ (qi * 0x01010101)
+    solver.add((data >> (bits - 32)) == (selector % (1 << 32)))
+    solver.add(value < (1 << 40), sender != 0)
+    if qi % 5 == 4:  # UNSAT lane
+        solver.add(value + 1 > (1 << 41), value < (1 << 39))
+    else:
+        solver.add(value + data != sender)
+    return solver._prepare([])
+
+
+def test_circuit_kernel_solves_the_bench_64bit_queries():
+    """Round-2 verdict item 1 done-criterion: every satisfiable 64-bit
+    bench-shaped query must solve DEVICE-SIDE (circuit kernel, resident
+    tensors) — the old WalkSAT kernel solved 0 of them."""
+    backend = DeviceSolverBackend(num_restarts=16)
+    preps = [_bench_like_query(qi) for qi in range(8)]
+    problems = [
+        (p.num_vars, p.clauses, (p.blaster.aig, p.blaster.last_roots))
+        for p in preps
+    ]
+    results = backend.try_solve_batch_circuit(
+        problems, budget_seconds=60.0,
+        size_caps=(4096, 1 << 22, 1 << 18),  # full caps on the CPU platform
+    )
+    for qi, (prep, bits) in enumerate(zip(preps, results)):
+        if qi % 5 == 4:
+            assert bits is None, f"query {qi} is UNSAT, kernel claimed SAT"
+        else:
+            assert bits is not None, f"satisfiable query {qi} not solved"
+            assert DeviceSolverBackend._honors(bits, prep.clauses)
+
+
+def test_circuit_kernel_solves_256bit_selector_dispatch():
+    """Same check at the 256-bit selector-dispatch shape."""
+    from mythril_tpu.smt import Extract, ULT
+
+    data = symbol_factory.BitVecSym("cq256_data", 256)
+    value = symbol_factory.BitVecSym("cq256_value", 256)
+    sender = symbol_factory.BitVecSym("cq256_sender", 256)
+    balance = symbol_factory.BitVecSym("cq256_balance", 256)
+    solver = Solver()
+    solver.add(Extract(255, 224, data) == symbol_factory.BitVecVal(0xAB125858, 32))
+    solver.add(ULT(value, symbol_factory.BitVecVal(1 << 40, 256)))
+    solver.add(sender != 0)
+    solver.add(balance + value != sender)
+    prep = solver._prepare([])
+    backend = DeviceSolverBackend(num_restarts=16)
+    results = backend.try_solve_batch_circuit(
+        [(prep.num_vars, prep.clauses,
+          (prep.blaster.aig, prep.blaster.last_roots))],
+        budget_seconds=120.0,
+        size_caps=(4096, 1 << 22, 1 << 18),  # full caps on the CPU platform
+    )
+    assert results[0] is not None, "256-bit dispatch query not solved"
+    assert DeviceSolverBackend._honors(results[0], prep.clauses)
