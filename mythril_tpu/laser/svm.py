@@ -253,6 +253,9 @@ class LaserEVM:
 
     def exec(self, create: bool = False, track_gas: bool = False):
         self._fire("start_exec")
+        # states that produced no successors — the ended/leaf states the
+        # VMTests harness asserts gas ranges on (reference svm.py:362-363)
+        final_states: List[GlobalState] = []
         start = time.monotonic()
         for global_state in self.strategy:
             if create and self.create_timeout:
@@ -324,9 +327,13 @@ class LaserEVM:
                                 pending.append(state)
                         new_states = ready
             self.manage_cfg(op_code, new_states)
-            self.work_list.extend(new_states)
+            if new_states:
+                self.work_list.extend(new_states)
+            elif track_gas:
+                final_states.append(global_state)
             self.total_states += len(new_states)
         self._fire("stop_exec")
+        return final_states if track_gas else None
 
     def execute_state(
         self, global_state: GlobalState

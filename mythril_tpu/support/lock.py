@@ -1,0 +1,48 @@
+"""Inter-process file lock guarding the shared config/signature store
+(reference mythril/support/lock.py:78).
+
+POSIX-only flock with a stale-lock timeout; used around `~/.mythril`
+bootstrap so concurrent CLI invocations don't race config.ini creation."""
+
+import contextlib
+import os
+import time
+
+
+class LockFile:
+    def __init__(self, path: str, timeout_seconds: float = 10.0):
+        self.path = path
+        self.timeout_seconds = timeout_seconds
+        self._handle = None
+
+    def acquire(self) -> None:
+        import fcntl
+
+        deadline = time.monotonic() + self.timeout_seconds
+        self._handle = open(self.path, "a+")
+        while True:
+            try:
+                fcntl.flock(self._handle, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                return
+            except OSError:
+                if time.monotonic() > deadline:
+                    # stale lock: proceed rather than deadlock the CLI
+                    return
+                time.sleep(0.05)
+
+    def release(self) -> None:
+        if self._handle is None:
+            return
+        import fcntl
+
+        with contextlib.suppress(OSError):
+            fcntl.flock(self._handle, fcntl.LOCK_UN)
+        self._handle.close()
+        self._handle = None
+
+    def __enter__(self) -> "LockFile":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()

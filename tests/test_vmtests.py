@@ -1,0 +1,195 @@
+"""Spec-conformance: replay the official Ethereum VMTests vectors through
+the engine with concrete transactions and assert the post-state.
+
+Mirrors the reference harness (tests/laser/evm_testsuite/evm_test.py:20-80)
+including its documented skip lists; the JSON vectors are read as DATA from
+the reference checkout (they are the upstream ethereum/tests corpus, not
+reference code)."""
+
+import binascii
+import json
+import os
+from pathlib import Path
+
+import pytest
+
+VMTESTS_DIR = Path("/root/reference/tests/laser/evm_testsuite/VMTests")
+
+pytestmark = pytest.mark.skipif(
+    not VMTESTS_DIR.is_dir(), reason="VMTests vectors not mounted"
+)
+
+TEST_TYPES = [
+    "vmArithmeticTest",
+    "vmBitwiseLogicOperation",
+    "vmEnvironmentalInfo",
+    "vmPushDupSwapTest",
+    "vmTests",
+    "vmSha3Test",
+    "vmSystemOperations",
+    "vmRandomTest",
+    "vmIOandFlowOperations",
+]
+
+# same documented gaps as the reference harness (evm_test.py:32-59)
+TESTS_WITH_GAS_SUPPORT = ["gas0", "gas1"]
+TESTS_WITH_BLOCK_NUMBER_SUPPORT = [
+    "BlockNumberDynamicJumpi0",
+    "BlockNumberDynamicJumpi1",
+    "BlockNumberDynamicJump0_jumpdest2",
+    "DynamicJumpPathologicalTest0",
+    "BlockNumberDynamicJumpifInsidePushWithJumpDest",
+    "BlockNumberDynamicJumpiAfterStop",
+    "BlockNumberDynamicJumpifInsidePushWithoutJumpDest",
+    "BlockNumberDynamicJump0_jumpdest0",
+    "BlockNumberDynamicJumpi1_jumpdest",
+    "BlockNumberDynamicJumpiOutsideBoundary",
+    "DynamicJumpJD_DependsOnJumps1",
+]
+TESTS_WITH_LOG_SUPPORT = ["log1MemExp"]
+TESTS_NOT_RELEVANT = [
+    "loop_stacklimit_1020",  # max_depth keeps us from looping to 1020
+    "loop_stacklimit_1021",
+]
+TESTS_TO_RESOLVE = [
+    "jumpTo1InstructionafterJump",
+    "sstore_load_2",
+    "jumpi_at_the_end",
+]
+IGNORED = set(
+    TESTS_WITH_GAS_SUPPORT
+    + TESTS_WITH_BLOCK_NUMBER_SUPPORT
+    + TESTS_WITH_LOG_SUPPORT
+    + TESTS_NOT_RELEVANT
+    + TESTS_TO_RESOLVE
+)
+
+
+def load_test_data(designations):
+    cases = []
+    if not VMTESTS_DIR.is_dir():
+        return cases
+    for designation in designations:
+        for file_reference in sorted((VMTESTS_DIR / designation).iterdir()):
+            if file_reference.suffix != ".json":
+                continue
+            with file_reference.open() as file:
+                top_level = json.load(file)
+            for test_name, data in top_level.items():
+                action = data["exec"]
+                gas_before = int(action["gas"], 16)
+                gas_after = data.get("gas")
+                gas_used = (
+                    gas_before - int(gas_after, 16)
+                    if gas_after is not None
+                    else None
+                )
+                cases.append((
+                    test_name,
+                    data.get("env"),
+                    data["pre"],
+                    action,
+                    gas_used,
+                    data.get("post", {}),
+                ))
+    return cases
+
+
+@pytest.mark.parametrize(
+    "test_name, environment, pre_condition, action, gas_used, post_condition",
+    load_test_data(TEST_TYPES),
+)
+def test_vmtest(test_name, environment, pre_condition, action, gas_used,
+                post_condition):
+    if test_name in IGNORED:
+        pytest.skip("documented engine gap (same skip list as reference)")
+
+    from mythril_tpu.disasm import Disassembly
+    from mythril_tpu.laser.state.account import Account
+    from mythril_tpu.laser.state.world_state import WorldState
+    from mythril_tpu.laser.svm import LaserEVM
+    from mythril_tpu.laser.transaction.concolic import execute_message_call
+    from mythril_tpu.laser.transaction.models import tx_id_manager
+    from mythril_tpu.smt import symbol_factory
+    from mythril_tpu.smt.bitvec import Expression
+    from mythril_tpu.support.args import args
+    from mythril_tpu.support.time_handler import time_handler
+
+    tx_id_manager.restart_counter()
+    args.pruning_factor = 1
+    world_state = WorldState()
+    for address, details in pre_condition.items():
+        account = world_state.create_account(
+            address=int(address, 16),
+            concrete_storage=True,
+            balance=int(details["balance"], 16),
+        )
+        account.code = Disassembly(details["code"][2:])
+        account.nonce = int(details["nonce"], 16)
+        for key, value in details["storage"].items():
+            key_bv = symbol_factory.BitVecVal(int(key, 16), 256)
+            account.storage[key_bv] = symbol_factory.BitVecVal(
+                int(value, 16), 256
+            )
+
+    time_handler.start_execution(10000)
+    laser_evm = LaserEVM()
+    laser_evm.open_states = [world_state]
+
+    final_states = execute_message_call(
+        laser_evm,
+        callee_address=int(action["address"], 16),
+        caller_address=int(action["caller"], 16),
+        origin_address=int(action["origin"], 16),
+        code=action["code"][2:],
+        gas_limit=int(action["gas"], 16),
+        data=list(binascii.a2b_hex(action["data"][2:])),
+        gas_price=int(action["gasPrice"], 16),
+        value=int(action["value"], 16),
+        track_gas=True,
+    )
+
+    if gas_used is not None and gas_used < int(
+        environment["currentGasLimit"], 16
+    ):
+        gas_min_max = [
+            (s.mstate.min_gas_used, s.mstate.max_gas_used)
+            for s in final_states
+        ]
+        assert all(low <= high for low, high in gas_min_max)
+        assert any(low <= gas_used for low, _high in gas_min_max)
+
+    if post_condition == {}:
+        # error or out-of-gas: no surviving world state
+        assert len(laser_evm.open_states) == 0
+    else:
+        assert len(laser_evm.open_states) == 1
+        world_state = laser_evm.open_states[0]
+        for address, details in post_condition.items():
+            account = world_state.accounts[int(address, 16)]
+            assert account.nonce == int(details["nonce"], 16)
+            expected_code = details["code"][2:]
+            actual_code = account.code.bytecode
+            if isinstance(actual_code, bytes):
+                actual_code = actual_code.hex()
+            assert actual_code == expected_code
+            for index, value in details["storage"].items():
+                expected = int(value, 16)
+                actual = account.storage[
+                    symbol_factory.BitVecVal(int(index, 16), 256)
+                ]
+                if isinstance(actual, Expression):
+                    actual = actual.value if not hasattr(actual, "concrete_value") \
+                        else actual.concrete_value
+                    actual = (
+                        1 if actual is True
+                        else 0 if actual is False
+                        else actual
+                    )
+                elif isinstance(actual, bytes):
+                    actual = int(binascii.b2a_hex(actual), 16)
+                elif isinstance(actual, str):
+                    actual = int(actual, 16)
+                assert actual == expected, (
+                    f"storage[{index}] = {actual}, expected {expected}"
+                )
