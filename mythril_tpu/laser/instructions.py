@@ -101,28 +101,34 @@ def concretize(global_state: GlobalState, value: BitVec, name: str) -> int:
 def execute(global_state: GlobalState, instr) -> List[GlobalState]:
     """Run one instruction. Raises Transaction*Signal / VmException."""
     name = instr.opcode
-    mstate = global_state.mstate
     spec = BY_NAME.get(name)
     if spec is None:
         raise InvalidInstruction(f"invalid opcode 0x{instr.byte:02x}")
     if global_state.environment.static and name in STATE_MODIFYING_OPS:
         raise WriteProtection(f"{name} inside STATICCALL")
-    mstate.min_gas_used += spec.gas_min
-    mstate.max_gas_used += spec.gas_max
-    mstate.check_gas()
 
     if name.startswith("PUSH"):
-        return _push(global_state, instr)
-    if name.startswith("DUP"):
-        return _dup(global_state, int(name[3:]))
-    if name.startswith("SWAP"):
-        return _swap(global_state, int(name[4:]))
-    if name.startswith("LOG"):
-        return _log(global_state, int(name[3:]))
-    handler = HANDLERS.get(name)
-    if handler is None:
-        raise InvalidInstruction(f"unimplemented opcode {name}")
-    return handler(global_state)
+        states = _push(global_state, instr)
+    elif name.startswith("DUP"):
+        states = _dup(global_state, int(name[3:]))
+    elif name.startswith("SWAP"):
+        states = _swap(global_state, int(name[4:]))
+    elif name.startswith("LOG"):
+        states = _log(global_state, int(name[3:]))
+    else:
+        handler = HANDLERS.get(name)
+        if handler is None:
+            raise InvalidInstruction(f"unimplemented opcode {name}")
+        states = handler(global_state)
+    # opcode gas accrues on the states the handler RETURNED — halting ops
+    # (STOP/RETURN/SELFDESTRUCT) raise a signal and never charge their own
+    # cost, matching reference StateTransition.accumulate_gas
+    # (instructions.py:163-172 runs after the handler)
+    for state in states:
+        state.mstate.min_gas_used += spec.gas_min
+        state.mstate.max_gas_used += spec.gas_max
+        state.mstate.check_gas()
+    return states
 
 
 def advance(global_state: GlobalState) -> List[GlobalState]:
@@ -953,6 +959,8 @@ def return_(global_state):
     offset_c = concrete_or_none(offset)
     if offset_c is None and length_c:
         offset_c = concretize(global_state, offset, "return_offset")
+    if length_c:
+        global_state.mstate.mem_extend(offset_c, length_c)
     data = [
         global_state.mstate.memory.get_byte(offset_c + i)
         for i in range(length_c)
@@ -970,6 +978,8 @@ def revert_(global_state):
     offset_c = concrete_or_none(offset)
     data = []
     if offset_c is not None:
+        if length_c:
+            global_state.mstate.mem_extend(offset_c, length_c)
         data = [
             global_state.mstate.memory.get_byte(offset_c + i)
             for i in range(length_c)
@@ -988,9 +998,10 @@ def invalid_(global_state):
 @op("SELFDESTRUCT")
 def selfdestruct_(global_state):
     s = global_state.mstate.stack
-    beneficiary = s.pop()
+    beneficiary = simplify(s.pop() & bv((1 << 160) - 1))  # address = low 160 bits
     world_state = global_state.world_state
     account = global_state.environment.active_account
+    world_state.accounts_exist_or_load(beneficiary)  # materialize recipient
     balance = world_state.balances[account.address]
     world_state.balances[beneficiary] = (
         world_state.balances[beneficiary] + balance

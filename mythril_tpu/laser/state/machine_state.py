@@ -8,6 +8,15 @@ from mythril_tpu.laser.state.memory import Memory
 
 STACK_LIMIT = 1024
 
+# EVM memory-expansion gas (yellow paper appendix G; reference
+# laser/ethereum/state/machine_state.py:171-191 via instruction_data.py).
+GAS_MEMORY = 3
+GAS_MEMORY_QUADRATIC_DENOMINATOR = 512
+
+
+def _ceil32(value: int) -> int:
+    return ((value + 31) // 32) * 32
+
 
 class MachineStack(list):
     def append(self, element) -> None:
@@ -55,9 +64,45 @@ class MachineState:
     def memory_size(self) -> int:
         return self.memory.size
 
+    def calculate_extension_size(self, start: int, size: int) -> int:
+        """Word-aligned growth needed to cover [start, start+size)
+        (reference machine_state.py:152-168)."""
+        if self.memory_size > start + size:
+            return 0
+        new_size = _ceil32(start + size) // 32
+        old_size = self.memory_size // 32
+        return (new_size - old_size) * 32
+
+    def calculate_memory_gas(self, start: int, size: int) -> int:
+        """Quadratic memory-expansion fee (reference machine_state.py:171-185)."""
+        oldsize = self.memory_size // 32
+        old_totalfee = (
+            oldsize * GAS_MEMORY + oldsize**2 // GAS_MEMORY_QUADRATIC_DENOMINATOR
+        )
+        newsize = _ceil32(start + size) // 32
+        new_totalfee = (
+            newsize * GAS_MEMORY + newsize**2 // GAS_MEMORY_QUADRATIC_DENOMINATOR
+        )
+        return new_totalfee - old_totalfee
+
     def mem_extend(self, start, size) -> None:
-        """Grow memory (concrete bounds only; symbolic bounds left unexpanded)."""
-        if isinstance(start, int) and isinstance(size, int):
+        """Grow memory, charging the expansion fee; symbolic bounds are left
+        unexpanded (reference machine_state.py:187-208)."""
+        if not isinstance(start, int):
+            if getattr(start, "symbolic", True):
+                return
+            start = start.concrete_value
+        if not isinstance(size, int):
+            if getattr(size, "symbolic", True):
+                return
+            size = size.concrete_value
+        if size == 0:
+            return
+        if self.calculate_extension_size(start, size):
+            extend_gas = self.calculate_memory_gas(start, size)
+            self.min_gas_used += extend_gas
+            self.max_gas_used += extend_gas
+            self.check_gas()
             self.memory.extend_to(start, size)
 
     def pop(self, amount: int = 1):

@@ -111,7 +111,10 @@ class BaseTransaction:
 
     def initial_global_state_from_environment(self, environment, active_function):
         world_state = self.world_state
-        global_state = GlobalState(world_state, environment)
+        global_state = GlobalState(
+            world_state, environment,
+            machine_state=MachineState(gas_limit=self.gas_limit),
+        )
         global_state.environment.active_function_name = active_function
         sender = environment.sender
         receiver = environment.active_account.address
