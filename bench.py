@@ -15,14 +15,20 @@ lowered and bit-blasted by the production pipeline
            a measured 3,116x slowdown). UNSAT/unsolved queries fall back
            to the CDCL, charged to the device measurement.
 
+Legs (each isolated in a subprocess with its own timeout, and each
+recording rc + stderr tail + wall so a wedged TPU tunnel, a compile
+blow-up, and a verdict mismatch are distinguishable post-hoc):
+
+  hello   — tiny fixed circuit; reports backend, compile time and run
+            time separately (fast triage: is the chip reachable at all?)
+  device  — the timed microbench (rate, verdicts, device_solved)
+  analyze — full `analyze` wall-clock on a pinned corpus input, cpu
+            vs tpu solver backend
+
 Prints ONE json line:
   {"metric": "sat_checks_per_sec", "value": <device rate>,
    "unit": "checks/s", "vs_baseline": <device rate / host CDCL rate>,
-   "extra": {device_solved, flips_per_sec, rounds, host_rate,
-             analyze_wall_cpu_s, analyze_wall_tpu_s}}
-
-The device leg runs in a subprocess with a timeout so a wedged TPU tunnel
-degrades to the host measurement (vs_baseline 1.0) instead of hanging.
+   "extra": {...per-leg diagnostics...}}
 """
 
 import json
@@ -34,9 +40,11 @@ import time
 NUM_QUERIES = int(os.environ.get("BENCH_QUERIES", 32))
 RESTARTS = int(os.environ.get("BENCH_RESTARTS", 16))
 BITS = 64
-STEPS = 192
+STEPS = 64
 MAX_ROUNDS = 8
-DEVICE_TIMEOUT_S = 900
+STALL_ROUNDS = 2  # stop after this many rounds with no new solves
+HELLO_TIMEOUT_S = 120
+DEVICE_TIMEOUT_S = 600
 ANALYZE_INPUT = "/root/reference/tests/testdata/inputs/flag_array.sol.o"
 
 
@@ -78,6 +86,57 @@ def host_rate(preps):
     return len(preps) / wall, wall, verdicts
 
 
+def hello_main():
+    """Tiny fixed-circuit probe: backend name, compile time, run time."""
+    import jax
+    import numpy as np
+
+    from mythril_tpu.tpu import circuit
+    from mythril_tpu.tpu.backend import _enable_compile_cache
+
+    _enable_compile_cache(jax)
+    t0 = time.monotonic()
+    backend = jax.default_backend()
+    init_s = time.monotonic() - t0
+
+    preps = build_queries(2)
+    packed = [
+        circuit.PackedCircuit(p.blaster.aig, p.blaster.last_roots)
+        for p in preps
+    ]
+    n_levels = max(p.num_levels for p in packed)
+    width = max(p.max_width for p in packed)
+    v1 = max(p.v1 for p in packed)
+    n_roots = max(p.num_roots for p in packed)
+    batch = {
+        k: np.stack([
+            p.padded_to(n_levels, width, v1, n_roots)[k] for p in packed
+        ])
+        for k in circuit.TENSOR_KEYS
+    }
+    tensors = {k: jax.device_put(jax.numpy.asarray(v))
+               for k, v in batch.items()}
+    x = jax.device_put(jax.random.bernoulli(
+        jax.random.PRNGKey(3), 0.5, (2, 8, v1)).astype(jax.numpy.int32))
+    keys = jax.random.split(jax.random.PRNGKey(4), 2)
+    t0 = time.monotonic()
+    out = circuit.run_round_circuit_batch(
+        tensors, x, keys, steps=8, walk_depth=n_levels + 4)
+    jax.block_until_ready(out)
+    compile_s = time.monotonic() - t0
+    t0 = time.monotonic()
+    out = circuit.run_round_circuit_batch(
+        tensors, x, keys, steps=8, walk_depth=n_levels + 4)
+    jax.block_until_ready(out)
+    run_s = time.monotonic() - t0
+    print(json.dumps({
+        "backend": backend,
+        "init_s": round(init_s, 2),
+        "compile_s": round(compile_s, 2),
+        "run_s": round(run_s, 4),
+    }))
+
+
 def device_rate(preps):
     import jax
     import numpy as np
@@ -117,7 +176,7 @@ def device_rate(preps):
 
     # the CPU platform only smoke-tests the path (driver runs this on TPU)
     on_cpu = jax.default_backend() == "cpu"
-    steps = 32 if on_cpu else STEPS
+    steps = 16 if on_cpu else STEPS
     max_rounds = 2 if on_cpu else MAX_ROUNDS
 
     # warm the jit cache before timing (driver: first compile 20-40 s)
@@ -126,27 +185,36 @@ def device_rate(preps):
 
     start = time.monotonic()
     solved = np.zeros((q,), dtype=bool)
+    best_rows = {}
     flips = 0
     rounds = 0
+    stall = 0
     for round_i in range(max_rounds):
         keys = jax.vmap(lambda k: jax.random.fold_in(k, round_i))(keys)
         x, found = circuit.run_round_circuit_batch(
             tensors, x, keys, steps=steps, walk_depth=walk_depth)
         rounds += 1
         flips += q * RESTARTS * steps
-        solved |= np.asarray(found).any(axis=1)
-        if solved.all():
+        found_np = np.asarray(found)
+        newly = found_np.any(axis=1) & ~solved
+        if newly.any():
+            stall = 0
+            x_np_round = np.asarray(x)
+            for slot in np.nonzero(newly)[0]:
+                row = int(np.argmax(found_np[slot]))
+                best_rows[int(slot)] = x_np_round[slot, row].copy()
+        else:
+            stall += 1
+        solved |= found_np.any(axis=1)
+        if solved.all() or stall >= STALL_ROUNDS:
             break
-    found_np = np.asarray(found)
-    x_np = np.asarray(x)
     checker = DeviceSolverBackend._honors
     verdicts = []
     device_solved = 0
     for qi, p in enumerate(packed):
         bits = None
-        if solved[qi] and found_np[qi].any():
-            row = int(np.argmax(found_np[qi]))
-            assignment = x_np[qi, row]
+        assignment = best_rows.get(qi)
+        if assignment is not None:
             bits = [False] * (preps[qi].num_vars + 1)
             for var in range(1, preps[qi].num_vars + 1):
                 bits[var] = bool(assignment[var])
@@ -171,29 +239,63 @@ def device_rate(preps):
     }
 
 
-def analyze_wall(backend: str) -> float:
-    """Wall-clock of a full `analyze` run on a pinned reference input."""
-    if not os.path.isfile(ANALYZE_INPUT):
-        return -1.0
-    start = time.monotonic()
+def _run_leg(argv, timeout, parse_stdout=True):
+    """Run a bench leg in a subprocess; always capture rc + stderr tail.
+    parse_stdout=True returns the last stdout line as JSON (rc 0 only);
+    parse_stdout=False returns raw stdout regardless of rc (the analyze
+    leg exits 1 when issues are found — that's its success case)."""
+    t0 = time.monotonic()
+    diag = {"wall_s": None, "rc": None, "stderr_tail": ""}
     try:
         proc = subprocess.run(
-            [sys.executable, "-m", "mythril_tpu", "analyze",
-             "-f", ANALYZE_INPUT, "-t", "1", "-o", "json",
-             "--solver-backend", backend],
-            capture_output=True, text=True, timeout=600,
+            argv, capture_output=True, text=True, timeout=timeout,
             cwd=os.path.dirname(os.path.abspath(__file__)),
         )
-    except (subprocess.SubprocessError, OSError):
-        return -4.0  # hung/crashed analyze leg: report, don't crash bench
-    wall = time.monotonic() - start
+        diag["rc"] = proc.returncode
+        diag["stderr_tail"] = (proc.stderr or "")[-2048:]
+        diag["wall_s"] = round(time.monotonic() - t0, 2)
+        if not parse_stdout:
+            return proc.stdout, diag
+        payload = None
+        if proc.returncode == 0 and proc.stdout.strip():
+            try:
+                payload = json.loads(proc.stdout.strip().splitlines()[-1])
+            except ValueError:
+                diag["stderr_tail"] = (
+                    "unparseable stdout: " + proc.stdout[-512:])
+        return payload, diag
+    except subprocess.TimeoutExpired as err:
+        diag["rc"] = "timeout"
+        diag["stderr_tail"] = ((err.stderr or b"").decode("utf-8", "replace")
+                               if isinstance(err.stderr, bytes)
+                               else (err.stderr or ""))[-2048:]
+        diag["wall_s"] = round(time.monotonic() - t0, 2)
+        return None, diag
+    except (OSError, subprocess.SubprocessError) as err:
+        diag["rc"] = "oserror"
+        diag["stderr_tail"] = str(err)
+        diag["wall_s"] = round(time.monotonic() - t0, 2)
+        return None, diag
+
+
+def analyze_wall(backend: str):
+    """Wall-clock of a full `analyze` run on a pinned reference input.
+    Returns (seconds_or_negative_code, diag)."""
+    if not os.path.isfile(ANALYZE_INPUT):
+        return -1.0, {}
+    argv = [sys.executable, "-m", "mythril_tpu", "analyze",
+            "-f", ANALYZE_INPUT, "-t", "1", "-o", "json",
+            "--solver-backend", backend]
+    payload, diag = _run_leg(argv, timeout=600, parse_stdout=False)
+    if diag["rc"] in ("timeout", "oserror"):
+        return -4.0, diag
     try:
-        issues = json.loads(proc.stdout.strip().splitlines()[-1])["issues"]
-        if not issues:
-            return -2.0  # lost the finding: report as failure, not speed
+        issues = json.loads(payload.strip().splitlines()[-1])["issues"]
     except Exception:
-        return -3.0
-    return wall
+        return -3.0, diag
+    if not issues:
+        return -2.0, diag  # lost the finding: failure, not speed
+    return diag["wall_s"], diag
 
 
 def child_main():
@@ -202,41 +304,45 @@ def child_main():
 
 
 def main():
+    this = os.path.abspath(__file__)
     preps = build_queries()
     h_rate, h_wall, h_verdicts = host_rate(preps)
 
-    result = None
-    try:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--child"],
-            capture_output=True, text=True, timeout=DEVICE_TIMEOUT_S,
-            cwd=os.path.dirname(os.path.abspath(__file__)),
-        )
-        if proc.returncode == 0 and proc.stdout.strip():
-            result = json.loads(proc.stdout.strip().splitlines()[-1])
-    except (subprocess.SubprocessError, OSError, ValueError):
-        result = None
+    hello, hello_diag = _run_leg(
+        [sys.executable, this, "--hello"], HELLO_TIMEOUT_S)
+    result, device_diag = _run_leg(
+        [sys.executable, this, "--child"], DEVICE_TIMEOUT_S)
 
-    analyze_cpu = analyze_wall("cpu")
-    analyze_tpu = analyze_wall("tpu")
+    analyze_cpu, analyze_cpu_diag = analyze_wall("cpu")
+    analyze_tpu, analyze_tpu_diag = analyze_wall("tpu")
 
     extra = {
         "host_rate": round(h_rate, 2),
         "analyze_wall_cpu_s": round(analyze_cpu, 2),
         "analyze_wall_tpu_s": round(analyze_tpu, 2),
+        "hello": hello if hello is not None else hello_diag,
     }
+    if analyze_cpu < 0:
+        extra["analyze_cpu_diag"] = analyze_cpu_diag
+    if analyze_tpu < 0:
+        extra["analyze_tpu_diag"] = analyze_tpu_diag
     if result is not None and result["verdicts"] == h_verdicts:
         value = result["rate"]
         vs = result["rate"] / h_rate if h_rate else 0.0
         extra.update({
             "device_solved": result["device_solved"],
+            "device_wall_s": round(result["wall"], 2),
             "flips_per_sec": result["flips_per_sec"],
             "rounds": result["rounds"],
         })
-    else:  # device leg unavailable (wedged tunnel) or verdict mismatch
+    else:  # device leg failed — the diag says how
         value = h_rate
         vs = 1.0
+        if result is not None:
+            device_diag["verdict_mismatch"] = {
+                "device": result["verdicts"], "host": h_verdicts}
         extra["device_leg"] = "unavailable-or-mismatch"
+        extra["device_diag"] = device_diag
     print(json.dumps({
         "metric": "sat_checks_per_sec",
         "value": round(value, 2),
@@ -249,5 +355,7 @@ def main():
 if __name__ == "__main__":
     if "--child" in sys.argv:
         child_main()
+    elif "--hello" in sys.argv:
+        hello_main()
     else:
         main()

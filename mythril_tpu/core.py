@@ -4,6 +4,7 @@ mythril_disassembler.py:411, merged into one module — the solc/RPC loading
 paths live in solidity/ and ethereum/ and are dispatched from here)."""
 
 import logging
+import time
 import traceback
 from typing import List, Optional
 
@@ -53,6 +54,127 @@ class MythrilDisassembler:
             contracts.extend(get_contracts_from_file(file))
         self.contracts.extend(contracts)
         return contracts
+
+    def load_from_foundry(self, project_root: Optional[str] = None,
+                          run_forge: bool = True):
+        """Analyze a foundry project: run `forge build --build-info` and load
+        every contract from the build-info artifacts (reference
+        mythril_disassembler.py:160-217). With run_forge=False only existing
+        artifacts are read — the offline-test path."""
+        import json
+        import os
+        import shutil
+        import subprocess
+
+        from mythril_tpu.solidity.soliditycontract import (
+            get_contracts_from_foundry,
+        )
+
+        project_root = project_root or os.getcwd()
+        if run_forge:
+            forge = shutil.which("forge")
+            if forge is None:
+                raise ValueError(
+                    "forge binary not found (install foundry or pass "
+                    "pre-built artifacts)"
+                )
+            proc = subprocess.run(
+                [forge, "build", "--build-info", "--force"],
+                capture_output=True, text=True, cwd=project_root,
+            )
+            if proc.stderr:
+                log.error(proc.stderr)
+            if proc.returncode:
+                # stale artifacts would silently analyze the OLD bytecode
+                raise ValueError(
+                    f"forge build failed (rc={proc.returncode}); refusing to "
+                    "analyze stale artifacts"
+                )
+        build_dir = None
+        for candidate in (
+            os.path.join(project_root, "artifacts", "contracts", "build-info"),
+            os.path.join(project_root, "out", "build-info"),
+        ):
+            if os.path.isdir(candidate):
+                build_dir = candidate
+                break
+        if build_dir is None:
+            raise ValueError(
+                f"no foundry build-info directory under {project_root} "
+                "(did `forge build --build-info` run?)"
+            )
+        files = sorted(
+            (f for f in os.listdir(build_dir) if f.endswith(".json")),
+            key=lambda f: os.path.getmtime(os.path.join(build_dir, f)),
+        )
+        if not files:
+            raise ValueError(f"{build_dir} has no build-info artifacts")
+        contracts = []
+        for file in files:
+            with open(os.path.join(build_dir, file), encoding="utf8") as fd:
+                build_info = json.load(fd)
+            contracts.extend(get_contracts_from_foundry(build_info))
+        self.contracts.extend(contracts)
+        return contracts
+
+    def get_state_variable_from_storage(
+        self, address: str, params: Optional[List[str]] = None
+    ) -> str:
+        """Read storage slots over RPC, including solidity layout math for
+        arrays and mappings (reference mythril_disassembler.py:330-410):
+        `[position, length]` reads consecutive slots, `[pos, len, "array"]`
+        starts at keccak(pos), `["mapping", pos, key...]` reads
+        keccak(key ++ pos) per key."""
+        from mythril_tpu.utils.keccak import keccak256
+
+        if self.eth is None:
+            raise ValueError("no RPC client configured (use --rpc)")
+        params = params or []
+        position, length, mappings = 0, 1, []
+
+        def slot_of(data: bytes) -> int:
+            return int.from_bytes(keccak256(data), byteorder="big")
+
+        try:
+            if params and params[0] == "mapping":
+                if len(params) < 3:
+                    raise ValueError("mapping requires a position and keys")
+                position = int(params[1])
+                position_bytes = int(position).to_bytes(32, "big")
+                for raw_key in params[2:]:
+                    key = raw_key.encode("utf8").ljust(32, b"\x00")
+                    mappings.append(slot_of(key + position_bytes))
+                length = len(mappings)
+                if length == 1:
+                    position = mappings[0]
+            else:
+                if len(params) >= 4:
+                    raise ValueError("too many parameters")
+                if len(params) >= 1:
+                    position = int(params[0])
+                if len(params) >= 2:
+                    length = int(params[1])
+                if len(params) == 3 and params[2] == "array":
+                    position = slot_of(int(position).to_bytes(32, "big"))
+        except ValueError as error:
+            raise ValueError(f"invalid storage index: {error}") from None
+
+        lines = []
+        if length == 1:
+            lines.append(
+                f"{position}: {self.eth.eth_getStorageAt(address, position)}"
+            )
+        elif mappings:
+            for slot in mappings:
+                lines.append(
+                    f"{hex(slot)}: {self.eth.eth_getStorageAt(address, slot)}"
+                )
+        else:
+            for slot in range(position, position + length):
+                lines.append(
+                    f"{hex(slot)}: {self.eth.eth_getStorageAt(address, slot)}"
+                )
+        return "\n".join(lines)
 
 
 class MythrilAnalyzer:
@@ -108,6 +230,9 @@ class MythrilAnalyzer:
             )
 
             keccak_function_manager.reset()
+            contract_start = time.monotonic()
+            solver_before = stats.solver_time
+            device_before = stats.device_stats()
             dynloader = None
             if self.eth is not None:
                 from mythril_tpu.support.loader import DynLoader
@@ -139,6 +264,8 @@ class MythrilAnalyzer:
                 issue.add_code_info(contract)
                 issue.resolve_function_name(_signature_db())
             log.info(str(stats))
+            log.info(self._phase_split(contract.name, contract_start,
+                                       solver_before, device_before, stats))
             all_issues.extend(issues)
 
         report = Report(
@@ -148,6 +275,33 @@ class MythrilAnalyzer:
         for issue in all_issues:
             report.append_issue(issue)
         return report
+
+    @staticmethod
+    def _phase_split(name, contract_start, solver_before, device_before,
+                     stats) -> str:
+        """Per-contract wall-clock split: interpreter / host solver / device
+        pack+ship / device solve. The architecture dial for batching work:
+        whichever phase dominates is what the next kernel targets."""
+        wall = time.monotonic() - contract_start
+        solver_s = stats.solver_time - solver_before
+        device = stats.device_stats()
+
+        def delta(key):
+            return device.get(key, 0.0) - device_before.get(key, 0.0)
+
+        pack_s = delta("pack_seconds")
+        ship_s = delta("ship_seconds")
+        solve_s = delta("solve_seconds")
+        # solver_time already folds in the device phases (add_batch records
+        # the full get_models_batch wall) — subtract once, not twice
+        interp_s = max(wall - solver_s, 0.0)
+        host_solver_s = max(solver_s - pack_s - ship_s - solve_s, 0.0)
+        return (
+            f"phase split [{name}]: wall={wall:.2f}s "
+            f"interpreter={interp_s:.2f}s host-solver={host_solver_s:.2f}s "
+            f"device-pack={pack_s:.2f}s device-ship={ship_s:.2f}s "
+            f"device-solve={solve_s:.2f}s"
+        )
 
     def dump_statespace(self, contract=None) -> str:
         """JSON statespace dump (reference mythril_analyzer.py:84)."""

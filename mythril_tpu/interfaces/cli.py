@@ -18,11 +18,19 @@ COMMAND_ALIASES = {"a": "analyze", "d": "disassemble", "c": "concolic"}
 
 
 def main() -> None:
+    # discover + load pip-installed `mythril_tpu.plugins` entry points
+    # (reference interfaces/cli.py:32)
+    from mythril_tpu.plugin import MythrilPluginLoader
+
+    _ = MythrilPluginLoader()
     parser = build_parser()
     argv = sys.argv[1:]
     if argv and argv[0] in COMMAND_ALIASES:
         argv[0] = COMMAND_ALIASES[argv[0]]
     parsed = parser.parse_args(argv)
+    if parsed.command == "help":
+        parser.print_help()
+        sys.exit(0)
     configure_logging(getattr(parsed, "verbose", 2))
     try:
         exit_code = execute_command(parsed)
@@ -77,7 +85,34 @@ def build_parser() -> argparse.ArgumentParser:
                           help="comma-separated branch addresses to flip")
     concolic.add_argument("--solver-timeout", type=int, default=100000)
 
+    foundry = subparsers.add_parser(
+        "foundry", help="analyze a foundry project (forge build artifacts)"
+    )
+    foundry.add_argument("--project-root", default=None,
+                         help="foundry project directory (default: cwd)")
+    foundry.add_argument("--skip-forge-build", action="store_true",
+                         help="read existing build-info artifacts only")
+    foundry.add_argument("-v", "--verbose", type=int, default=2)
+    add_analysis_args(foundry)
+    add_output_args(foundry)
+
+    read_storage = subparsers.add_parser(
+        "read-storage",
+        help="read storage slots of an on-chain contract over RPC",
+    )
+    read_storage.add_argument(
+        "storage_slots",
+        help="position | position,length | position,length,array | "
+             "mapping,position,key1[,key2...]",
+    )
+    read_storage.add_argument("address", help="contract address")
+    read_storage.add_argument("--rpc", help="custom RPC endpoint host:port")
+    read_storage.add_argument("--rpctls", action="store_true")
+    read_storage.add_argument("-v", "--verbose", type=int, default=2)
+
     subparsers.add_parser("version", help="print version")
+    subparsers.add_parser("help", add_help=False,
+                          help="print this help message")
     return parser
 
 
@@ -246,10 +281,33 @@ def execute_command(parsed) -> int:
         print(json.dumps(output))
         return 0
 
-    if command in ("analyze", "safe-functions"):
+    if command == "read-storage":
+        from mythril_tpu.core import MythrilDisassembler
+        from mythril_tpu.ethereum.interface.client import EthJsonRpc
+
+        eth = EthJsonRpc.from_cli(parsed.rpc, parsed.rpctls)
+        disassembler = MythrilDisassembler(eth=eth)
+        print(disassembler.get_state_variable_from_storage(
+            parsed.address, parsed.storage_slots.split(",")))
+        return 0
+
+    if command in ("analyze", "safe-functions", "foundry"):
         from mythril_tpu.core import MythrilAnalyzer
 
-        disassembler = _build_disassembler_and_load(parsed)
+        if command == "foundry":
+            from mythril_tpu.core import MythrilDisassembler
+
+            disassembler = MythrilDisassembler()
+            try:
+                disassembler.load_from_foundry(
+                    parsed.project_root,
+                    run_forge=not parsed.skip_forge_build,
+                )
+            except (ValueError, NotImplementedError) as error:
+                raise CliError(str(error))
+            command = "analyze"
+        else:
+            disassembler = _build_disassembler_and_load(parsed)
         address = None
         if getattr(parsed, "address", None):
             address = int(parsed.address, 16)

@@ -84,15 +84,30 @@ def concrete_or_none(value: BitVec):
 
 
 def concretize(global_state: GlobalState, value: BitVec, name: str) -> int:
-    """Force a concrete value via the solver (pins it with a constraint)."""
+    """Force a concrete value via the solver (pins it with a constraint).
+
+    An UNSAT path (or solver timeout) must kill THIS path, not the whole
+    exploration — raise a VmException so execute_state retires the state
+    like any other exceptional halt."""
     value = simplify(value)
     if not value.symbolic:
         return value.concrete_value
+    from mythril_tpu.smt.solver.frontend import (
+        SolverTimeOutException,
+        UnsatError,
+    )
     from mythril_tpu.support.model import get_model
 
-    model = get_model(
-        global_state.world_state.constraints.get_all_constraints()
-    )
+    try:
+        model = get_model(
+            global_state.world_state.constraints.get_all_constraints()
+        )
+    except UnsatError:
+        raise VmException(f"infeasible path at {name} concretization") \
+            from None
+    except SolverTimeOutException:
+        raise VmException(f"solver timeout at {name} concretization") \
+            from None
     concrete = model.eval_int(value)
     global_state.world_state.constraints.append(value == bv(concrete))
     return concrete
@@ -502,20 +517,30 @@ def calldatasize_(global_state):
     return advance(global_state)
 
 
+APPROX_COPY_BYTES = 320  # bound for symbolic-length copies (keeps len FREE)
+
+
 def _copy_to_memory(global_state, mem_offset, data_offset, length, reader):
-    """Shared body of *COPY ops; concretizes bounds via the solver."""
+    """Shared body of *COPY ops.
+
+    A symbolic length must NOT be solver-concretized: pinning it (the model
+    usually picks 0) contradicts later guards like require(len > 0) and
+    silently kills every continuing path. Following the reference's
+    approximation (instructions.py _calldata_copy_helper: "the excess size
+    will get overwritten"), a bounded number of source bytes is copied
+    unconditionally and `length` stays unconstrained."""
     mem_offset_c = concrete_or_none(mem_offset)
     if mem_offset_c is None:
         mem_offset_c = concretize(global_state, mem_offset, "copy_dest")
     length_c = concrete_or_none(length)
+    memory = global_state.mstate.memory
     if length_c is None:
-        length_c = concretize(global_state, length, "copy_len")
-    length_c = min(length_c, 0x10000)  # sanity cap
+        length_c = APPROX_COPY_BYTES
+    else:
+        length_c = min(length_c, 0x10000)  # sanity cap
     global_state.mstate.mem_extend(mem_offset_c, length_c)
     for i in range(length_c):
-        global_state.mstate.memory.write_byte(
-            mem_offset_c + i, reader(data_offset, i)
-        )
+        memory.write_byte(mem_offset_c + i, reader(data_offset, i))
 
 
 def _calldata_copy(global_state, mem_offset, data_offset, length):

@@ -1,0 +1,16 @@
+from mythril_tpu.plugin.discovery import PluginDiscovery
+from mythril_tpu.plugin.interface import (
+    MythrilCLIPlugin,
+    MythrilLaserPlugin,
+    MythrilPlugin,
+)
+from mythril_tpu.plugin.loader import MythrilPluginLoader, UnsupportedPluginType
+
+__all__ = [
+    "PluginDiscovery",
+    "MythrilPlugin",
+    "MythrilCLIPlugin",
+    "MythrilLaserPlugin",
+    "MythrilPluginLoader",
+    "UnsupportedPluginType",
+]

@@ -59,7 +59,25 @@ class SolverStatistics:
                     f", device-eligible: {self.device_batch_queries}"
                     f" (hits: {self.device_batch_hits})"
                     f", device-ineligible: {self.device_ineligible}")
+        device = self.device_stats()
+        if device:
+            out += (f", device pack/ship/solve: {device['pack_seconds']}"
+                    f"/{device['ship_seconds']}/{device['solve_seconds']} s"
+                    f" (pack cache {device['pack_hits']} hits"
+                    f"/{device['pack_misses']} misses,"
+                    f" {device['cap_rejects']} cap-rejects)")
         return out
+
+    @staticmethod
+    def device_stats() -> dict:
+        """Per-stage timing of the device solver (pack/ship/solve), if the
+        backend was ever instantiated. Feeds the per-contract stats line and
+        bench.py's extra diagnostics."""
+        from mythril_tpu.tpu import backend as device_backend
+
+        if device_backend._backend is None:
+            return {}
+        return device_backend._backend.stats()
 
 
 def stat_smt_query(func):

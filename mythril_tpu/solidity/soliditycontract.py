@@ -120,7 +120,8 @@ def _strip_placeholders(bytecode: str) -> str:
 
 
 class SolidityContract(EVMContract):
-    def __init__(self, input_file: str, name: str, solc_output: dict):
+    def __init__(self, input_file: str, name: str, solc_output: dict,
+                 source_text: Optional[str] = None):
         contracts = solc_output["contracts"][input_file]
         data = contracts[name]
         evm = data["evm"]
@@ -138,8 +139,10 @@ class SolidityContract(EVMContract):
         self.abi = data.get("abi", [])
         self.solc_ast = solc_output.get("sources", {}).get(
             input_file, {}).get("ast")  # feeds laser/tx_prioritiser.py
-        with open(input_file) as handle:
-            self.source_text = handle.read()
+        if source_text is None:
+            with open(input_file) as handle:
+                source_text = handle.read()
+        self.source_text = source_text
 
     @staticmethod
     def _build_source_index(solc_output: dict) -> Dict[int, str]:
@@ -194,4 +197,26 @@ def get_contracts_from_file(
         raise NoContractFoundError(
             f"no deployable contract found in {input_file}"
         )
+    return contracts
+
+
+def get_contracts_from_foundry(build_info: dict) -> List[SolidityContract]:
+    """All deployable contracts in one `forge build --build-info` artifact
+    (reference soliditycontract.py:141 get_contracts_from_foundry +
+    mythril_disassembler.py:160 load_from_foundry). The build-info JSON
+    carries solc standard-json "input" (with source text) and "output"
+    (bytecode + srcmaps), so no file reads or solc invocation is needed."""
+    if build_info.get("input", {}).get("language", "Solidity") != "Solidity":
+        raise NotImplementedError("only Solidity foundry projects supported")
+    output = build_info["output"]
+    sources_in = build_info.get("input", {}).get("sources", {})
+    contracts = []
+    for input_file, per_file in output.get("contracts", {}).items():
+        source_text = sources_in.get(input_file, {}).get("content", "")
+        for name, data in per_file.items():
+            if not data.get("evm", {}).get(
+                    "deployedBytecode", {}).get("object"):
+                continue
+            contracts.append(SolidityContract(
+                input_file, name, output, source_text=source_text))
     return contracts

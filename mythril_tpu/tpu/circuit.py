@@ -299,3 +299,41 @@ def run_round_circuit_batch(tensors: dict, x, keys, steps: int,
 def init_inputs(key, num_restarts: int, v1: int):
     x = jax.random.bernoulli(key, 0.5, (num_restarts, v1)).astype(jnp.int32)
     return x.at[:, 0].set(0)
+
+
+def make_sharded_round(mesh, steps: int, walk_depth: int):
+    """Build THE production multi-device round function: queries sharded
+    data-parallel over mesh axis "dp", restarts over "mp"; per-shard RNG
+    decorrelated via axis_index; the solved verdict reduced with mesh
+    collectives. Used by DeviceSolverBackend when the platform has >1
+    device and by the driver's dryrun_multichip — one code path.
+
+    Returns fn(tensors, x, keys) -> (x, found, solved) where tensors have a
+    leading query axis divisible by dp, x is [Q, R, V1] with R divisible by
+    mp, keys is [Q, 2]."""
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def sharded_round(tensors, x, keys):
+        shard_id = (jax.lax.axis_index("dp") * jnp.uint32(7919)
+                    + jax.lax.axis_index("mp"))
+        keys = jax.vmap(lambda k: jax.random.fold_in(k, shard_id))(keys)
+        x, found = run_round_circuit_batch(
+            tensors, x, keys, steps=steps, walk_depth=walk_depth)
+        # query q is solved iff ANY restart on ANY mp shard found a model
+        solved = jax.lax.pmax(jnp.max(found, axis=1), "mp")
+        return x, found, solved
+
+    tensor_spec = {
+        k: P("dp", *([None] * (2 if k in
+             ("out_idx", "a_var", "a_neg", "b_var", "b_neg") else 1)))
+        for k in TENSOR_KEYS
+    }
+    return jax.jit(
+        shard_map(
+            sharded_round,
+            mesh=mesh,
+            in_specs=(tensor_spec, P("dp", "mp", None), P("dp", None)),
+            out_specs=(P("dp", "mp", None), P("dp", "mp"), P("dp")),
+        )
+    )

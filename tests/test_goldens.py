@@ -1,0 +1,127 @@
+"""Golden-output regression tests against the reference's checked-in
+expected artifacts (tests/testdata/outputs_expected/*.easm) plus this
+repo's own report-format snapshots (tests/testdata/expected_reports/).
+
+The reference regenerates + diffs these artifacts in its all_tests.sh; here
+the .easm files are read as DATA (they are disassembler output listings,
+not code)."""
+
+import glob
+import json
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+EXPECTED = "/root/reference/tests/testdata/outputs_expected"
+INPUTS = "/root/reference/tests/testdata/inputs"
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SNAPSHOTS = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "testdata", "expected_reports")
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(EXPECTED), reason="reference testdata not mounted"
+)
+
+# the reference's easm goldens predate two opcode renames in the EVM spec;
+# its current opcode table (support/opcodes.py) uses the modern names, as
+# does this repo — treat the legacy spellings as equal
+LEGACY_NAMES = {"SUICIDE": "SELFDESTRUCT", "ASSERT_FAIL": "INVALID"}
+
+# golden generated from an older compile of the contract (input file starts
+# 0x6080..., golden disassembles 0x6060...): stale artifact, not a parity gap
+STALE_GOLDENS = {"overflow.sol.o"}
+
+
+def _normalize_easm(text: str) -> str:
+    lines = []
+    for line in text.strip().splitlines():
+        parts = line.split()
+        if len(parts) >= 2 and parts[1] in LEGACY_NAMES:
+            parts[1] = LEGACY_NAMES[parts[1]]
+        lines.append(" ".join(parts))
+    return "\n".join(lines)
+
+
+@pytest.mark.parametrize(
+    "golden",
+    sorted(glob.glob(os.path.join(EXPECTED, "*.easm"))),
+    ids=lambda path: os.path.basename(path),
+)
+def test_easm_matches_reference_golden(golden):
+    from mythril_tpu.ethereum.evmcontract import EVMContract
+
+    name = os.path.basename(golden)[: -len(".easm")]
+    if name in STALE_GOLDENS:
+        pytest.skip("reference golden predates the checked-in input")
+    with open(os.path.join(INPUTS, name)) as handle:
+        code = handle.read().strip()
+    mine = EVMContract(code, name="MAIN").get_easm()
+    with open(golden) as handle:
+        want = handle.read()
+    assert _normalize_easm(mine) == _normalize_easm(want)
+
+
+# --- full-report snapshots (text + jsonv2) ---------------------------------
+
+SNAPSHOT_CASES = [
+    ("suicide.sol.o", 1),
+    ("origin.sol.o", 1),
+    ("exceptions_0.8.0.sol.o", 1),
+]
+
+
+def _run_report(file_name: str, tx_count: int, outform: str) -> str:
+    proc = subprocess.run(
+        [sys.executable, "-m", "mythril_tpu", "analyze",
+         "-f", os.path.join(INPUTS, file_name),
+         "-t", str(tx_count), "-o", outform, "--solver-timeout", "60000"],
+        capture_output=True, text=True, timeout=900, cwd=REPO_ROOT,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.stdout.strip(), f"no output; stderr:\n{proc.stderr[-2000:]}"
+    return proc.stdout
+
+
+def _normalize_text_report(text: str) -> str:
+    # estimated gas numbers move with gas-model tuning; pin structure, not gas
+    return re.sub(r"Estimated Gas Usage: \d+ - \d+", "Estimated Gas Usage: X",
+                  text).strip()
+
+
+def _normalize_jsonv2(text: str) -> str:
+    data = json.loads(text.strip().splitlines()[-1])
+    for result in data:
+        for issue in result.get("issues", []):
+            issue.pop("extra", None)  # carries per-run solver models
+    return json.dumps(data, indent=1, sort_keys=True)
+
+
+@pytest.mark.parametrize("file_name, tx_count", SNAPSHOT_CASES,
+                         ids=[c[0] for c in SNAPSHOT_CASES])
+def test_text_report_snapshot(file_name, tx_count):
+    got = _normalize_text_report(_run_report(file_name, tx_count, "text"))
+    path = os.path.join(SNAPSHOTS, file_name + ".text")
+    if not os.path.exists(path):  # first run records the snapshot
+        os.makedirs(SNAPSHOTS, exist_ok=True)
+        with open(path, "w") as handle:
+            handle.write(got + "\n")
+        pytest.skip("snapshot recorded")
+    with open(path) as handle:
+        assert got == handle.read().strip()
+
+
+@pytest.mark.parametrize("file_name, tx_count", SNAPSHOT_CASES,
+                         ids=[c[0] for c in SNAPSHOT_CASES])
+def test_jsonv2_report_snapshot(file_name, tx_count):
+    got = _normalize_jsonv2(_run_report(file_name, tx_count, "jsonv2"))
+    path = os.path.join(SNAPSHOTS, file_name + ".jsonv2")
+    if not os.path.exists(path):
+        os.makedirs(SNAPSHOTS, exist_ok=True)
+        with open(path, "w") as handle:
+            handle.write(got + "\n")
+        pytest.skip("snapshot recorded")
+    with open(path) as handle:
+        assert got == handle.read().strip()

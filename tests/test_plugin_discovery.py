@@ -1,0 +1,68 @@
+"""Package-level plugin system (reference mythril/plugin/): entry-point
+discovery, type dispatch, default-enabled autoloading."""
+
+import pytest
+
+from mythril_tpu.analysis.module.base import DetectionModule
+from mythril_tpu.analysis.module.loader import ModuleLoader
+from mythril_tpu.plugin import (
+    MythrilPlugin,
+    MythrilPluginLoader,
+    PluginDiscovery,
+    UnsupportedPluginType,
+)
+
+
+class _DemoDetector(MythrilPlugin, DetectionModule):
+    name = "DemoDetector"
+    swc_id = "000"
+    description = "demo"
+    entry_point = None
+    pre_hooks = []
+    post_hooks = []
+    plugin_default_enabled = True
+
+    def _execute(self, state):
+        return []
+
+
+@pytest.fixture
+def discovery():
+    disc = PluginDiscovery()
+    saved = disc._installed_plugins
+    disc._installed_plugins = {"demo-detector": _DemoDetector}
+    yield disc
+    disc._installed_plugins = saved
+
+
+def test_discovery_lists_and_builds(discovery):
+    assert discovery.is_installed("demo-detector")
+    assert not discovery.is_installed("absent")
+    assert discovery.get_plugins() == ["demo-detector"]
+    assert discovery.get_plugins(default_enabled=True) == ["demo-detector"]
+    assert discovery.get_plugins(default_enabled=False) == []
+    plugin = discovery.build_plugin("demo-detector")
+    assert isinstance(plugin, _DemoDetector)
+    with pytest.raises(ValueError):
+        discovery.build_plugin("absent")
+
+
+def test_loader_registers_detection_module(discovery):
+    loader = MythrilPluginLoader()
+    before = len(ModuleLoader().get_detection_modules())
+    plugin = discovery.build_plugin("demo-detector")
+    loader.load(plugin)
+    modules = ModuleLoader().get_detection_modules()
+    assert any(m.name == "DemoDetector" for m in modules)
+    assert plugin in loader.loaded_plugins
+    # unregister so other tests see the stock module set
+    ModuleLoader()._modules.remove(plugin)
+    assert len(ModuleLoader().get_detection_modules()) == before
+
+
+def test_loader_rejects_untyped_plugins():
+    loader = MythrilPluginLoader()
+    with pytest.raises(ValueError):
+        loader.load(object())
+    with pytest.raises(UnsupportedPluginType):
+        loader.load(MythrilPlugin())
