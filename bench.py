@@ -45,7 +45,22 @@ MAX_ROUNDS = 8
 STALL_ROUNDS = 2  # stop after this many rounds with no new solves
 HELLO_TIMEOUT_S = 120
 DEVICE_TIMEOUT_S = 600
-ANALYZE_INPUT = "/root/reference/tests/testdata/inputs/flag_array.sol.o"
+INPUTS_DIR = "/root/reference/tests/testdata/inputs"
+ANALYZE_INPUT = os.path.join(INPUTS_DIR, "flag_array.sol.o")
+
+# BASELINE.md configs 1-5 proxy: pinned corpus analyze sweep, cpu vs tpu
+# solver backend, asserting issue-set equality per input (the reference's
+# solidity_examples corpus needs solc; the testdata corpus is the compiled
+# equivalent available in this env). One deep -t 3 case included.
+CORPUS = (
+    ("flag_array.sol.o", 1, ()),            # config 1 proxy (single-tx 105)
+    ("underflow.sol.o", 2, ()),             # config 2 proxy (QF_BV arith)
+    ("ether_send.sol.o", 2, ("--bin-runtime",)),  # deep symbolic storage
+    ("calls.sol.o", 3, ()),                 # config 3/4 proxy (-t 3, calls)
+    ("suicide.sol.o", 1, ()),
+    ("exceptions.sol.o", 2, ()),
+)
+CORPUS_LEG_TIMEOUT_S = 420
 
 
 def build_queries(num_queries: int = NUM_QUERIES):
@@ -278,24 +293,79 @@ def _run_leg(argv, timeout, parse_stdout=True):
         return None, diag
 
 
-def analyze_wall(backend: str):
-    """Wall-clock of a full `analyze` run on a pinned reference input.
-    Returns (seconds_or_negative_code, diag)."""
-    if not os.path.isfile(ANALYZE_INPUT):
-        return -1.0, {}
-    argv = [sys.executable, "-m", "mythril_tpu", "analyze",
-            "-f", ANALYZE_INPUT, "-t", "1", "-o", "json",
-            "--solver-backend", backend]
-    payload, diag = _run_leg(argv, timeout=600, parse_stdout=False)
-    if diag["rc"] in ("timeout", "oserror"):
-        return -4.0, diag
-    try:
-        issues = json.loads(payload.strip().splitlines()[-1])["issues"]
-    except Exception:
-        return -3.0, diag
-    if not issues:
-        return -2.0, diag  # lost the finding: failure, not speed
-    return diag["wall_s"], diag
+def corpus_sweep(run_tpu: bool = True):
+    """Per-input analyze wall cpu vs tpu + issue-set equality (the
+    north-star proxy: zero missed findings and corpus-level wall-clock).
+
+    run_tpu=False skips every tpu leg — set when the device hello probe
+    failed (a wedged TPU tunnel makes each tpu subprocess hang to its full
+    timeout; probing once bounds the damage)."""
+    table = {}
+    total_cpu = total_tpu = 0.0
+    all_equal = True
+    backends = ("cpu", "tpu") if run_tpu else ("cpu",)
+    for name, tx_count, extra_args in CORPUS:
+        path = os.path.join(INPUTS_DIR, name)
+        if not os.path.isfile(path):
+            continue
+        row = {"t": tx_count}
+        issue_sets = {}
+        for backend in backends:
+            argv = [sys.executable, "-m", "mythril_tpu", "analyze",
+                    "-f", path, "-t", str(tx_count), "-o", "json",
+                    "--solver-timeout", "10000",
+                    "--solver-backend", backend] + list(extra_args)
+            stdout, diag = _run_leg(argv, CORPUS_LEG_TIMEOUT_S,
+                                    parse_stdout=False)
+            if diag["rc"] in ("timeout", "oserror"):
+                row[backend] = {"fail": diag["rc"],
+                                "stderr_tail": diag["stderr_tail"][-300:]}
+                continue
+            try:
+                issues = json.loads(
+                    stdout.strip().splitlines()[-1])["issues"]
+            except Exception:
+                row[backend] = {"fail": "unparseable",
+                                "stderr_tail": diag["stderr_tail"][-300:]}
+                continue
+            issue_sets[backend] = sorted(
+                (i["swc-id"], i["function"]) for i in issues)
+            row[backend] = {"wall_s": diag["wall_s"],
+                            "issues": len(issues)}
+        if "cpu" in issue_sets and "tpu" in issue_sets:
+            row["issues_equal"] = issue_sets["cpu"] == issue_sets["tpu"]
+            total_cpu += row["cpu"]["wall_s"]
+            total_tpu += row["tpu"]["wall_s"]
+            if not row["issues_equal"]:
+                all_equal = False
+        else:
+            all_equal = False
+        table[name] = row
+    summary = {
+        "inputs": len(table),
+        # an empty sweep proves nothing — never report a vacuous pass
+        "zero_missed_findings": all_equal and len(table) == len(CORPUS),
+        "corpus_cpu_s": round(total_cpu, 1),
+        "corpus_tpu_s": round(total_tpu, 1),
+        "corpus_speedup_tpu": (
+            round(total_cpu / total_tpu, 3) if total_tpu else None),
+    }
+    return table, summary
+
+
+def _analyze_wall_from_corpus(table, backend: str) -> float:
+    """Headline analyze wall for the pinned input, derived from the corpus
+    row (negative codes: -4 leg failed, -3 unparseable, -2 lost the
+    finding, -1 input missing)."""
+    row = table.get(os.path.basename(ANALYZE_INPUT))
+    if row is None:
+        return -1.0
+    leg = row.get(backend)
+    if leg is None or "fail" in leg:
+        return -3.0 if leg and leg.get("fail") == "unparseable" else -4.0
+    if not leg.get("issues"):
+        return -2.0  # lost the finding: failure, not speed
+    return leg["wall_s"]
 
 
 def child_main():
@@ -310,22 +380,27 @@ def main():
 
     hello, hello_diag = _run_leg(
         [sys.executable, this, "--hello"], HELLO_TIMEOUT_S)
-    result, device_diag = _run_leg(
-        [sys.executable, this, "--child"], DEVICE_TIMEOUT_S)
+    device_available = hello is not None
+    if device_available:
+        result, device_diag = _run_leg(
+            [sys.executable, this, "--child"], DEVICE_TIMEOUT_S)
+    else:
+        # wedged tunnel: every later TPU leg would burn its full timeout
+        result, device_diag = None, {
+            "rc": "skipped", "stderr_tail": "hello probe failed", "wall_s": 0}
 
-    analyze_cpu, analyze_cpu_diag = analyze_wall("cpu")
-    analyze_tpu, analyze_tpu_diag = analyze_wall("tpu")
+    corpus_table, corpus_summary = corpus_sweep(run_tpu=device_available)
+    analyze_cpu = _analyze_wall_from_corpus(corpus_table, "cpu")
+    analyze_tpu = _analyze_wall_from_corpus(corpus_table, "tpu")
 
     extra = {
         "host_rate": round(h_rate, 2),
         "analyze_wall_cpu_s": round(analyze_cpu, 2),
         "analyze_wall_tpu_s": round(analyze_tpu, 2),
         "hello": hello if hello is not None else hello_diag,
+        "corpus": corpus_table,
+        "corpus_summary": corpus_summary,
     }
-    if analyze_cpu < 0:
-        extra["analyze_cpu_diag"] = analyze_cpu_diag
-    if analyze_tpu < 0:
-        extra["analyze_tpu_diag"] = analyze_tpu_diag
     if result is not None and result["verdicts"] == h_verdicts:
         value = result["rate"]
         vs = result["rate"] / h_rate if h_rate else 0.0

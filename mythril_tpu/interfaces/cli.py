@@ -27,6 +27,20 @@ def main() -> None:
     argv = sys.argv[1:]
     if argv and argv[0] in COMMAND_ALIASES:
         argv[0] = COMMAND_ALIASES[argv[0]]
+    if "--epic" in argv:
+        # re-run self piped through the rainbow filter (reference cli.py:907)
+        argv.remove("--epic")
+        import subprocess
+
+        epic = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "epic.py")
+        child = subprocess.Popen(
+            [sys.executable, "-m", "mythril_tpu"] + argv,
+            stdout=subprocess.PIPE)
+        filt = subprocess.Popen([sys.executable, epic], stdin=child.stdout)
+        child.stdout.close()
+        filt.communicate()
+        sys.exit(child.wait())
     parsed = parser.parse_args(argv)
     if parsed.command == "help":
         parser.print_help()
@@ -53,6 +67,7 @@ def build_parser() -> argparse.ArgumentParser:
     )
     parser.add_argument("--version", action="version",
                         version=f"mythril_tpu {__version__}")
+    parser.add_argument("--epic", action="store_true", help=argparse.SUPPRESS)
     subparsers = parser.add_subparsers(dest="command")
 
     analyze = subparsers.add_parser("analyze", help="analyze a contract")

@@ -229,3 +229,38 @@ def test_result_cache_verifies_equality_on_hit():
     finally:
         Term.__hash__ = real_hash
         model_mod._result_cache.clear()
+
+
+def test_independence_solver_partitions_and_merges():
+    """IndependenceSolver (reference independence_solver.py:38): disjoint
+    clusters solve separately; a single UNSAT bucket sinks the set; models
+    merge across buckets."""
+    from mythril_tpu.smt import symbol_factory
+    from mythril_tpu.smt.solver.independence_solver import (
+        DependenceMap,
+        IndependenceSolver,
+    )
+
+    a = symbol_factory.BitVecSym("ind_a", 64)
+    b = symbol_factory.BitVecSym("ind_b", 64)
+    c = symbol_factory.BitVecSym("ind_c", 64)
+    d = symbol_factory.BitVecSym("ind_d", 64)
+
+    # two independent clusters: {a, b} and {c, d}
+    dep = DependenceMap()
+    for cond in (a == b + 1, c == 5, d == c + 2, b == 10):
+        dep.add_condition(cond.raw)
+    assert len(dep.buckets) == 2
+    sizes = sorted(len(bucket.conditions) for bucket in dep.buckets)
+    assert sizes == [2, 2]
+
+    solver = IndependenceSolver(timeout=10.0)
+    solver.add(a == b + 1, b == 10, c == 5, d == c + 2)
+    assert solver.check() == "sat"
+    model = solver.model()
+    assert model.eval_int(a) == 11
+    assert model.eval_int(d) == 7
+
+    unsat = IndependenceSolver(timeout=10.0)
+    unsat.add(a == b + 1, b == 10, c == 5, c == 6)  # second bucket impossible
+    assert unsat.check() == "unsat"
