@@ -116,7 +116,7 @@ def hello_main():
 
     preps = build_queries(2)
     packed = [
-        circuit.PackedCircuit(p.blaster.aig, p.blaster.last_roots)
+        circuit.PackedCircuit(p.aig_roots[0], p.aig_roots[1])
         for p in preps
     ]
     n_levels = max(p.num_levels for p in packed)
@@ -163,7 +163,7 @@ def device_rate(preps):
 
     _enable_compile_cache(jax)
     packed = [
-        circuit.PackedCircuit(p.blaster.aig, p.blaster.last_roots)
+        circuit.PackedCircuit(p.aig_roots[0], p.aig_roots[1])
         for p in preps
     ]
     assert all(p.ok for p in packed)
@@ -230,9 +230,8 @@ def device_rate(preps):
         bits = None
         assignment = best_rows.get(qi)
         if assignment is not None:
-            bits = [False] * (preps[qi].num_vars + 1)
-            for var in range(1, preps[qi].num_vars + 1):
-                bits[var] = bool(assignment[var])
+            bits = DeviceSolverBackend.bits_from_circuit_assignment(
+                p, preps[qi].var_dense, preps[qi].num_vars, assignment)
             if not checker(bits, preps[qi].clauses):
                 bits = None
         if bits is not None:

@@ -18,13 +18,22 @@ FALSE_LIT = 0
 TRUE_LIT = 1
 
 
+_AIG_UID = 0
+
+
 class AIG:
-    """And-Inverter Graph with structural hashing."""
+    """And-Inverter Graph with structural hashing. Append-only: a root
+    literal's cone never changes once created, so (aig.uid, roots) is a
+    sound cache key for packed/blasted artifacts."""
 
     def __init__(self):
+        global _AIG_UID
+        _AIG_UID += 1
+        self.uid = _AIG_UID
         self.num_vars = 0          # var 0 reserved for constant TRUE/FALSE
-        self.gates: List[Tuple[int, int]] = []  # gate i -> (lhs_lit, rhs_lit); output var = gate_var[i]
-        self.gate_vars: List[int] = []
+        # gate output var -> (lhs_lit, rhs_lit); insertion-ordered, so it
+        # doubles as the creation-order gate list
+        self.gate_of_var: Dict[int, Tuple[int, int]] = {}
         self._strash: Dict[Tuple[int, int], int] = {}
 
     def new_var(self) -> int:
@@ -50,8 +59,7 @@ class AIG:
         if hit is not None:
             return hit
         var = self.new_var()
-        self.gates.append((a, b))
-        self.gate_vars.append(var)
+        self.gate_of_var[var] = (a, b)
         lit = 2 * var
         self._strash[key] = lit
         return lit
@@ -74,35 +82,42 @@ class AIG:
         `roots` are asserted true; `defined` literals only get their defining
         gate clauses emitted (used by Optimize to constrain objective bits
         via SAT assumptions without asserting them).
-        Returns (num_vars, clauses) with DIMACS-style signed literal ints.
+
+        The cone's variables are renumbered into a DENSE 1..N space — the
+        AIG is shared across problems (frontend get_global_blaster), and a
+        CNF in global numbering would make every solve pay O(all vars ever
+        blasted). Returns (num_dense_vars, clauses, dense_of_global) where
+        clauses use DIMACS-signed DENSE literals.
         """
         clauses: List[Tuple[int, ...]] = []
 
-        def dimacs(lit: int) -> int:
-            var = lit >> 1
-            return -var if lit & 1 else var
-
-        # find reachable gates
+        # find reachable gates (gate_of_var is maintained incrementally so a
+        # small cone never pays for the whole shared AIG)
         needed = set()
         stack = [r >> 1 for r in list(roots) + list(defined) if r >> 1 != 0]
-        gate_index = {v: i for i, v in enumerate(self.gate_vars)}
         while stack:
             var = stack.pop()
             if var in needed:
                 continue
             needed.add(var)
-            gi = gate_index.get(var)
-            if gi is not None:
-                lhs, rhs = self.gates[gi]
-                for lit in (lhs, rhs):
+            gate = self.gate_of_var.get(var)
+            if gate is not None:
+                for lit in gate:
                     if lit >> 1 != 0:
                         stack.append(lit >> 1)
 
-        for gi, var in enumerate(self.gate_vars):
-            if var not in needed:
-                continue
-            lhs, rhs = self.gates[gi]
-            g, a, b = var, dimacs(lhs), dimacs(rhs)
+        dense = {var: i for i, var in enumerate(sorted(needed), start=1)}
+
+        def dimacs(lit: int) -> int:
+            var = dense[lit >> 1]
+            return -var if lit & 1 else var
+
+        for var in sorted(needed):
+            gate = self.gate_of_var.get(var)
+            if gate is None:
+                continue  # circuit input
+            lhs, rhs = gate
+            g, a, b = dense[var], dimacs(lhs), dimacs(rhs)
             clauses.append((-g, a))
             clauses.append((-g, b))
             clauses.append((g, -a, -b))
@@ -114,7 +129,7 @@ class AIG:
                 continue
             else:
                 clauses.append((dimacs(root),))
-        return self.num_vars, clauses
+        return len(dense), clauses, dense
 
 
 class Blaster:
@@ -124,8 +139,12 @@ class Blaster:
         self.aig = AIG()
         self._bv_cache: Dict[int, List[int]] = {}
         self._bool_cache: Dict[int, int] = {}
-        # symbol name -> list of var ids (LSB first) for model extraction
-        self.bv_symbol_vars: Dict[str, List[int]] = {}
+        # memo keys are id(term): pin every memoized term so it cannot be
+        # garbage collected — a reused id would make the cache return
+        # another term's literals (the blaster outlives single problems)
+        self._pinned: List[Term] = []
+        # (name, width) -> var ids (LSB first) for model extraction
+        self.bv_symbol_vars: Dict[Tuple[str, int], List[int]] = {}
         self.bool_symbol_vars: Dict[str, int] = {}
 
     # -- public -------------------------------------------------------------
@@ -153,6 +172,7 @@ class Blaster:
             return hit
         lit = self._bool_compute(term)
         self._bool_cache[id(term)] = lit
+        self._pinned.append(term)
         return lit
 
     def _bool_compute(self, term: Term) -> int:
@@ -238,6 +258,7 @@ class Blaster:
         bits = self._bv_compute(term)
         assert len(bits) == term.size, f"{term.op}: {len(bits)} != {term.size}"
         self._bv_cache[id(term)] = bits
+        self._pinned.append(term)
         return bits
 
     def _bv_compute(self, term: Term) -> List[int]:
@@ -247,11 +268,14 @@ class Blaster:
         if op == "const":
             return [TRUE_LIT if (term.value >> i) & 1 else FALSE_LIT for i in range(size)]
         if op == "sym":
-            name = term.params[0]
-            cached = self.bv_symbol_vars.get(name)
+            # keyed by (name, size): the blaster outlives one problem, and
+            # an unrelated same-named symbol of another width must not
+            # alias (model reconstruction writes per-name, latest wins)
+            key = (term.params[0], size)
+            cached = self.bv_symbol_vars.get(key)
             if cached is None:
                 cached = [aig.new_var() for _ in range(size)]
-                self.bv_symbol_vars[name] = cached
+                self.bv_symbol_vars[key] = cached
             return [2 * v for v in cached]
         child_bits = [self._bv(c) for c in term.children if isinstance(c.sort, int)]
         if op == "bvand":

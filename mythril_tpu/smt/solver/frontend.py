@@ -260,7 +260,7 @@ class _Prepared:
 
     __slots__ = ("trivial", "original", "lowering", "blaster",
                  "num_vars", "clauses", "objective_bits", "last_bits",
-                 "substitutions")
+                 "substitutions", "aig_roots", "symbols", "var_dense")
 
     def __init__(self):
         self.trivial: Optional[str] = None
@@ -273,6 +273,38 @@ class _Prepared:
         self.last_bits: Optional[List[bool]] = None
         # (name, definition) pairs eliminated by propagate_equalities
         self.substitutions: List[Tuple[str, Term]] = []
+        # (aig, root literals) snapshot for THIS problem — with the shared
+        # global blaster, blaster.last_roots belongs to whoever blasted last
+        self.aig_roots: Optional[Tuple] = None
+        # free symbols of THIS problem's lowered terms: the shared blaster's
+        # symbol tables span every problem ever blasted, so reconstruction
+        # must filter to these (same-named symbols from other problems would
+        # otherwise leak into — and corrupt — the model)
+        self.symbols: Optional[set] = None
+        # global AIG var -> dense CNF var (the cone's compact numbering)
+        self.var_dense: dict = {}
+
+
+_global_blaster: Optional[Blaster] = None
+_global_blaster_generation = -1
+BLASTER_VAR_CAP = 20_000_000  # reset past this to bound memory
+
+
+def get_global_blaster() -> Blaster:
+    """Process-wide blaster: terms are hash-consed (smt/terms.py), so its
+    id-keyed memo + structurally-hashed AIG persist across solver calls —
+    repeated confirmation queries share their blasted cones instead of
+    rebuilding them. Resets when the term intern table is cleared (the memo
+    keys would dangle) or when the AIG outgrows the var cap."""
+    global _global_blaster, _global_blaster_generation
+    if (
+        _global_blaster is None
+        or _global_blaster_generation != terms.Term.generation
+        or _global_blaster.aig.num_vars > BLASTER_VAR_CAP
+    ):
+        _global_blaster = Blaster()
+        _global_blaster_generation = terms.Term.generation
+    return _global_blaster
 
 
 class Solver:
@@ -362,21 +394,27 @@ class Solver:
             return prep
 
         prep.lowering = lowering
-        prep.blaster = Blaster()
+        prep.blaster = get_global_blaster()
         objective_lits: List[int] = []
         prep.objective_bits = []
         for lowered_obj in lowered_objectives:
             bits = prep.blaster.bv_bits(lowered_obj)
             prep.objective_bits.append(bits)
             objective_lits.extend(bits)
-        prep.num_vars, prep.clauses = prep.blaster.cnf(lowered, objective_lits)
+        prep.num_vars, prep.clauses, prep.var_dense = prep.blaster.cnf(
+            lowered, objective_lits)
+        prep.aig_roots = (prep.blaster.aig, list(prep.blaster.last_roots),
+                          prep.var_dense)
+        prep.symbols = {
+            (name, sort)
+            for (name, sort) in terms.free_symbols(
+                list(lowered) + list(lowered_objectives))
+        }
         return prep
 
     def _solve_prepared(self, prep: "_Prepared",
                         assumptions: List[int] = ()) -> str:
-        aig_roots = None
-        if prep.blaster is not None and not assumptions:
-            aig_roots = (prep.blaster.aig, prep.blaster.last_roots)
+        aig_roots = prep.aig_roots if not assumptions else None
         status, bits = sat_backend.solve_cnf(
             prep.num_vars,
             prep.clauses,
@@ -426,14 +464,30 @@ class Solver:
     def _reconstruct(self, prep: "_Prepared", bits: List[bool]) -> Model:
         blaster, lowering = prep.blaster, prep.lowering
         assignment: Dict = {}
-        for name, var_list in blaster.bv_symbol_vars.items():
-            value = 0
-            for i, var in enumerate(var_list):
-                if bits[var]:
-                    value |= 1 << i
-            assignment[name] = value
-        for name, var in blaster.bool_symbol_vars.items():
-            assignment[name] = bits[var]
+        # the blaster is shared across problems: symbols allocated AFTER
+        # this prep's CNF snapshot have vars past len(bits) — they are not
+        # part of this problem and default to 0 via model completion
+        dense = prep.var_dense
+        # iterate THIS problem's symbols (prep.symbols), not the shared
+        # blaster's tables, which accumulate every symbol ever blasted
+        for name, sort in prep.symbols or ():
+            if sort == terms.BOOL:
+                var = blaster.bool_symbol_vars.get(name)
+                if var is None:
+                    continue
+                dvar = dense.get(var)
+                assignment[name] = bits[dvar] if dvar is not None else False
+            elif isinstance(sort, int):
+                var_list = blaster.bv_symbol_vars.get((name, sort))
+                if var_list is None:
+                    continue
+                value = 0
+                for i, var in enumerate(var_list):
+                    dvar = dense.get(var)
+                    # bits outside the cone are unconstrained -> 0
+                    if dvar is not None and bits[dvar]:
+                        value |= 1 << i
+                assignment[name] = value
         # rebuild array tables from the ackermannized reads
         for arr_name, reads in lowering.array_reads.items():
             entries = {}
@@ -476,7 +530,14 @@ class Optimize(Solver):
     """Lexicographic minimize/maximize via MSB-first bit fixing.
 
     The problem is lowered and blasted ONCE; each bit probe is a SAT call
-    under assumptions on the shared CNF (no re-lowering/re-blasting)."""
+    under assumptions on the shared CNF (no re-lowering/re-blasting).
+
+    Past OPTIMIZE_CLAUSE_CAP clauses the probes are skipped and the first
+    model stands: on multiplier-bearing confirmation queries (~1M clauses,
+    seconds per CDCL call) minimizing calldata cosmetics multiplied the
+    per-issue cost several-fold for no soundness gain."""
+
+    OPTIMIZE_CLAUSE_CAP = 200_000
 
     def __init__(self, timeout: Optional[float] = None):
         super().__init__(timeout)
@@ -500,6 +561,8 @@ class Optimize(Solver):
         status = self._solve_prepared(prep)
         if status != SAT:
             return status
+        if len(prep.clauses) > self.OPTIMIZE_CLAUSE_CAP:
+            return SAT  # keep the first model; probes would dwarf the solve
         deadline = time.monotonic() + (self.timeout or 10.0)
         assumptions: List[int] = []  # DIMACS lits, grown lexicographically
         for (direction, _), bit_lits in zip(self._objectives, prep.objective_bits):
@@ -517,12 +580,13 @@ class Optimize(Solver):
         the rest are probed as SAT assumptions over the shared CNF. The best
         model found is kept in self._model."""
         prefer_negative = direction == "min"
+        dense = prep.var_dense
         for aig_lit in reversed(bit_lits):  # MSB first
             if time.monotonic() > deadline:
                 return
-            var = aig_lit >> 1
-            if var == 0:
-                continue  # constant bit: nothing to decide
+            var = dense.get(aig_lit >> 1)
+            if not var:
+                continue  # constant bit (or outside the cone): undecidable
             dimacs = -var if aig_lit & 1 else var
             trial = -dimacs if prefer_negative else dimacs
             # witnessed-bit skip: if the current model already has this bit at

@@ -102,6 +102,7 @@ class IndependenceSolver:
             dep_map.add_condition(getattr(constraint, "raw", constraint))
         self._models = []
         self._last_status = None
+        unknown = False
         for bucket in dep_map.buckets:
             sub = Solver(timeout=self.timeout)
             sub.add(bucket.conditions)
@@ -110,11 +111,13 @@ class IndependenceSolver:
                 self._last_status = UNSAT
                 return UNSAT  # one impossible bucket sinks the whole set
             if status != SAT:
-                self._last_status = UNKNOWN
-                return UNKNOWN
+                # keep scanning: a later bucket may still prove UNSAT (a
+                # timeout on one cluster must not hide a provable verdict)
+                unknown = True
+                continue
             self._models.append(sub.model())
-        self._last_status = SAT
-        return SAT
+        self._last_status = UNKNOWN if unknown else SAT
+        return self._last_status
 
     def model(self) -> Model:
         if self._last_status != SAT:

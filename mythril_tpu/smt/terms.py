@@ -30,9 +30,27 @@ def to_unsigned(value: int, size: int) -> int:
 
 
 class Term:
+    """Hash-consed: every construction goes through an intern table keyed by
+    (op, params, sort, value, child identities), so structurally equal terms
+    ARE the same object. This makes equality checks O(1) in the common case
+    and lets downstream id-keyed memo tables (the bit-blaster, the lowering
+    pass) hit across solver calls — repeated confirmation queries share
+    their multiplier/keccak cones instead of re-blasting them."""
+
     __slots__ = ("op", "children", "params", "sort", "_hash", "is_const", "value")
 
-    def __init__(self, op, children, params, sort, value=None):
+    _intern: Dict[tuple, "Term"] = {}
+    _INTERN_CAP = 8_000_000
+    generation = 0  # bumped on clear; consumers key their caches on it
+
+    def __new__(cls, op, children, params, sort, value=None):
+        key = (op, params, sort, value, tuple(map(id, children)))
+        hit = cls._intern.get(key)
+        if hit is not None:
+            return hit
+        if len(cls._intern) > cls._INTERN_CAP:
+            clear_intern()
+        self = super().__new__(cls)
         self.op = op
         self.children = children  # tuple of Term
         self.params = params      # tuple of static data (ints, names, FuncDecl)
@@ -42,6 +60,11 @@ class Term:
         self._hash = hash(
             (op, params, sort, value, tuple(c._hash for c in children))
         )
+        cls._intern[key] = self
+        return self
+
+    def __init__(self, op, children, params, sort, value=None):
+        pass  # fully initialized (or reused) in __new__
 
     def __hash__(self):
         return self._hash
@@ -82,6 +105,16 @@ class Term:
     def size(self) -> int:
         assert isinstance(self.sort, int), f"not a bitvector: {self.sort}"
         return self.sort
+
+
+def clear_intern() -> None:
+    """Drop the intern table (live terms stay valid; sharing restarts).
+    Consumers holding id-keyed caches over terms must key on `generation`."""
+    Term._intern.clear()
+    Term.generation += 1
+    # the singletons must stay interned: EVERY bool constant site uses them
+    Term._intern[("true", (), BOOL, True, ())] = TRUE
+    Term._intern[("false", (), BOOL, False, ())] = FALSE
 
 
 # ---------------------------------------------------------------------------

@@ -205,7 +205,7 @@ def get_models_batch(
             # device path: it searches over AIG inputs, so blasted
             # arithmetic actually solves (tpu/circuit.py)
             problems = [
-                (p.num_vars, p.clauses, (p.blaster.aig, p.blaster.last_roots))
+                (p.num_vars, p.clauses, p.aig_roots)
                 for _, _, _, p in eligible
             ]
             bits_list = backend.try_solve_batch_circuit(

@@ -10,7 +10,7 @@ The backend is process-global (jit/pack caches are expensive); statistics
 feed bench.py and SolverStatistics.
 """
 
-import hashlib
+
 import logging
 import os
 import time
@@ -25,21 +25,12 @@ log = logging.getLogger(__name__)
 
 
 def _circuit_struct_key(aig, roots) -> tuple:
-    """Structural digest of (AIG, roots) — the pack/pad/ship cache key.
-    Memoized on the aig object: sibling queries in one analyze frequently
-    share the blasted circuit skeleton, and re-levelizing it in Python was
-    the dominant per-call cost (round-3 verdict weak #4)."""
-    digest = getattr(aig, "_struct_digest", None)
-    if digest is None:
-        h = hashlib.blake2b(digest_size=16)
-        h.update(np.int64(aig.num_vars).tobytes())
-        h.update(np.asarray(aig.gate_vars, dtype=np.int64).tobytes())
-        gates = np.asarray(aig.gates, dtype=np.int64) if aig.gates else \
-            np.zeros((0, 2), dtype=np.int64)
-        h.update(gates.tobytes())
-        digest = h.digest()
-        aig._struct_digest = digest
-    return (digest, tuple(roots))
+    """(aig identity, roots) — the pack/pad/ship cache key. The AIG is
+    append-only with structural hashing (bitblast.py), so a root literal's
+    cone is fully determined by (aig.uid, roots): sibling queries blasted
+    into the shared global AIG re-levelize and re-upload nothing (round-3
+    verdict weak #4)."""
+    return (getattr(aig, "uid", id(aig)), tuple(roots))
 
 
 class _LRU(OrderedDict):
@@ -312,10 +303,15 @@ class DeviceSolverBackend:
             level_cap, cell_cap, v1_cap = self._platform_caps(jax, circuit)
 
         pack_start = time.monotonic()
-        packed: List[Tuple[int, int, object]] = []  # (orig idx, num_vars, pc)
-        for qi, (num_vars, clauses, (aig, roots)) in enumerate(problems):
+        # entries: (orig idx, num_vars, pc, struct key, dense map or None)
+        packed: List[Tuple[int, int, object, object, object]] = []
+        for qi, (num_vars, clauses, aig_roots) in enumerate(problems):
             if num_vars == 0:
                 continue
+            # (aig, roots) or (aig, roots, dense_of_global) — dense maps the
+            # shared AIG's var ids onto the problem's compact CNF numbering
+            aig, roots = aig_roots[0], aig_roots[1]
+            dense = aig_roots[2] if len(aig_roots) > 2 else None
             skey = _circuit_struct_key(aig, roots)
             pc, hit = self._pack_cache.get_or(
                 skey, lambda: circuit.PackedCircuit(aig, roots))
@@ -329,7 +325,7 @@ class DeviceSolverBackend:
                 and pc.num_levels * pc.max_width <= cell_cap
                 and pc.v1 <= v1_cap
             ):
-                packed.append((qi, num_vars, pc, skey))
+                packed.append((qi, num_vars, pc, skey, dense))
             elif pc.ok:
                 self.cap_rejects += 1
         self.pack_seconds += time.monotonic() - pack_start
@@ -347,10 +343,10 @@ class DeviceSolverBackend:
                 size *= 2
             return size
 
-        n_levels = _bucket(max(p.num_levels for _, _, p, _ in packed) or 1)
-        width = _bucket(max(p.max_width for _, _, p, _ in packed))
-        v1 = _bucket(max(p.v1 for _, _, p, _ in packed))
-        n_roots = _bucket(max(p.num_roots for _, _, p, _ in packed))
+        n_levels = _bucket(max(p.num_levels for _, _, p, _, _ in packed) or 1)
+        width = _bucket(max(p.max_width for _, _, p, _, _ in packed))
+        v1 = _bucket(max(p.v1 for _, _, p, _, _ in packed))
+        n_roots = _bucket(max(p.num_roots for _, _, p, _, _ in packed))
         walk_depth = min(n_levels + 4, circuit.MAX_LEVELS)
 
         mesh = self._get_mesh(jax)
@@ -376,7 +372,7 @@ class DeviceSolverBackend:
             )
             return entry
 
-        padded = [_padded_device(p, skey) for _, _, p, skey in packed]
+        padded = [_padded_device(p, skey) for _, _, p, skey, _ in packed]
         # query-axis padding: zero tensors have no live roots, so padding
         # slots report found at step 0 and stay frozen
         if q > len(packed):
@@ -458,13 +454,12 @@ class DeviceSolverBackend:
                     + fresh[:, :half] * unsolved
                 )
 
-        for slot, (qi, num_vars, p, _skey) in enumerate(packed):
+        for slot, (qi, num_vars, p, _skey, dense) in enumerate(packed):
             assignment = best_rows.get(slot)
             if assignment is None:
                 continue
-            bits = [False] * (num_vars + 1)
-            for var in range(1, min(num_vars, p.num_vars) + 1):
-                bits[var] = bool(assignment[var])
+            bits = self.bits_from_circuit_assignment(
+                p, dense, num_vars, assignment)
             if self._honors(bits, problems[qi][1]):
                 results[qi] = bits
                 self.batch_sat += 1
@@ -623,6 +618,21 @@ class DeviceSolverBackend:
         solved, found, x_host = self._round_loop(
             jax, round_fn, x, keys, q_pad, len(live), v_pad, deadline)
         return solved, found, x_host, live
+
+    @staticmethod
+    def bits_from_circuit_assignment(pc, dense, num_vars, assignment):
+        """Translate a cone-local circuit assignment into CNF model bits.
+
+        `pc.var_map` maps local -> global AIG var; `dense` (or None for
+        identity) maps global -> the problem's compact CNF numbering. Used
+        by the production batch path and bench.py — one encoding, one
+        implementation."""
+        bits = [False] * (num_vars + 1)
+        for lvar, gvar in enumerate(pc.var_map):
+            cvar = dense.get(gvar) if dense is not None else gvar
+            if cvar is not None and 0 < cvar <= num_vars:
+                bits[cvar] = bool(assignment[lvar])
+        return bits
 
     @staticmethod
     def _honors(bits: List[bool], clauses: Sequence[Tuple[int, ...]]) -> bool:

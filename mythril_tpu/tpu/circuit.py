@@ -50,15 +50,14 @@ class PackedCircuit:
     `ok` is False when the roots are trivially unsatisfiable or the
     circuit exceeds the device caps."""
 
-    __slots__ = ("num_vars", "v1", "num_levels", "max_width",
+    __slots__ = ("var_map", "v1", "num_levels", "max_width",
                  "out_idx", "a_var", "a_neg", "b_var", "b_neg",
                  "ga_var", "ga_neg", "gb_var", "gb_neg", "is_gate",
                  "root_var", "root_neg", "root_mask", "ok", "num_roots")
 
     def __init__(self, aig, roots: List[int]):
         self.ok = False
-        self.num_vars = aig.num_vars
-        gate_index = {v: i for i, v in enumerate(aig.gate_vars)}
+        gate_of_var = aig.gate_of_var  # incremental index (append-only AIG)
 
         live_roots = []
         for lit in roots:
@@ -76,12 +75,12 @@ class PackedCircuit:
             if var in level:
                 stack.pop()
                 continue
-            gi = gate_index.get(var)
-            if gi is None:
+            gate = gate_of_var.get(var)
+            if gate is None:
                 level[var] = 0  # input
                 stack.pop()
                 continue
-            lhs, rhs = aig.gates[gi]
+            lhs, rhs = gate
             children = (lhs >> 1, rhs >> 1)
             missing = [c for c in children if c not in level]
             if missing:
@@ -91,8 +90,19 @@ class PackedCircuit:
                 stack.pop()
 
         num_levels = max(level.values(), default=0)
-        if num_levels > MAX_LEVELS or aig.num_vars + 1 > MAX_VARS:
+        if num_levels > MAX_LEVELS or len(level) > MAX_VARS:
             return
+
+        # compact local variable space: the AIG is SHARED across problems
+        # (solver/frontend.py get_global_blaster), so tensors sized by the
+        # global var count would grow with every query ever blasted. Local
+        # id 0 stays the constant; var_map maps local -> global for model
+        # extraction.
+        cone_vars = sorted(v for v in level if v != 0)
+        self.var_map = [0] + cone_vars
+        local = {0: 0}
+        for i, var in enumerate(cone_vars, start=1):
+            local[var] = i
 
         by_level: List[List[int]] = [[] for _ in range(num_levels + 1)]
         for var, lv in level.items():
@@ -100,7 +110,7 @@ class PackedCircuit:
                 by_level[lv].append(var)
         max_width = max((len(g) for g in by_level[1:]), default=1) or 1
 
-        v1 = aig.num_vars + 1
+        v1 = len(self.var_map)
         self.v1 = v1
         self.num_levels = num_levels
         self.max_width = max_width
@@ -118,15 +128,17 @@ class PackedCircuit:
         is_gate = np.zeros_like(ga_var)
         for lv in range(1, num_levels + 1):
             for slot, var in enumerate(by_level[lv]):
-                lhs, rhs = aig.gates[gate_index[var]]
-                out_idx[lv - 1, slot] = var
-                a_var[lv - 1, slot] = lhs >> 1
+                lhs, rhs = gate_of_var[var]
+                lvar = local[var]
+                la, lb = local[lhs >> 1], local[rhs >> 1]
+                out_idx[lv - 1, slot] = lvar
+                a_var[lv - 1, slot] = la
                 a_neg[lv - 1, slot] = lhs & 1
-                b_var[lv - 1, slot] = rhs >> 1
+                b_var[lv - 1, slot] = lb
                 b_neg[lv - 1, slot] = rhs & 1
-                ga_var[var], ga_neg[var] = lhs >> 1, lhs & 1
-                gb_var[var], gb_neg[var] = rhs >> 1, rhs & 1
-                is_gate[var] = 1
+                ga_var[lvar], ga_neg[lvar] = la, lhs & 1
+                gb_var[lvar], gb_neg[lvar] = lb, rhs & 1
+                is_gate[lvar] = 1
 
         self.out_idx, self.a_var, self.a_neg = out_idx, a_var, a_neg
         self.b_var, self.b_neg = b_var, b_neg
@@ -139,7 +151,7 @@ class PackedCircuit:
         root_neg = np.zeros_like(root_var)
         root_mask = np.zeros_like(root_var)
         for i, lit in enumerate(live_roots):
-            root_var[i] = lit >> 1
+            root_var[i] = local[lit >> 1]
             root_neg[i] = lit & 1
             root_mask[i] = 1
         self.root_var, self.root_neg, self.root_mask = (

@@ -20,15 +20,17 @@ def simulate(blaster, lit, assignment_bits):
     def lit_val(literal):
         return values[literal >> 1] ^ bool(literal & 1)
 
-    for gate_var, (lhs, rhs) in zip(aig.gate_vars, aig.gates):
+    for gate_var, (lhs, rhs) in aig.gate_of_var.items():
         values[gate_var] = lit_val(lhs) and lit_val(rhs)
     return lit_val(lit)
 
 
 def bits_assignment(blaster, values_by_name):
     out = {}
+    by_name = {name: vars_ for (name, _size), vars_
+               in blaster.bv_symbol_vars.items()}
     for name, value in values_by_name.items():
-        for i, var in enumerate(blaster.bv_symbol_vars[name]):
+        for i, var in enumerate(by_name[name]):
             out[var] = bool((value >> i) & 1)
     return out
 

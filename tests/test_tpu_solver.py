@@ -100,7 +100,7 @@ def test_circuit_kernel_solves_the_bench_64bit_queries():
     backend = DeviceSolverBackend(num_restarts=16)
     preps = [_bench_like_query(qi) for qi in range(8)]
     problems = [
-        (p.num_vars, p.clauses, (p.blaster.aig, p.blaster.last_roots))
+        (p.num_vars, p.clauses, p.aig_roots)
         for p in preps
     ]
     results = backend.try_solve_batch_circuit(
@@ -131,8 +131,7 @@ def test_circuit_kernel_solves_256bit_selector_dispatch():
     prep = solver._prepare([])
     backend = DeviceSolverBackend(num_restarts=16)
     results = backend.try_solve_batch_circuit(
-        [(prep.num_vars, prep.clauses,
-          (prep.blaster.aig, prep.blaster.last_roots))],
+        [(prep.num_vars, prep.clauses, prep.aig_roots)],
         budget_seconds=120.0,
         size_caps=(4096, 1 << 22, 1 << 18),  # full caps on the CPU platform
     )
@@ -147,7 +146,7 @@ def test_pack_and_ship_caches_hit_across_calls():
     backend = DeviceSolverBackend(num_restarts=16)
     preps = [_bench_like_query(qi) for qi in range(2)]
     problems = [
-        (p.num_vars, p.clauses, (p.blaster.aig, p.blaster.last_roots))
+        (p.num_vars, p.clauses, p.aig_roots)
         for p in preps
     ]
     first = backend.try_solve_batch_circuit(
@@ -183,8 +182,7 @@ def test_circuit_kernel_executes_analyze_scale_circuit():
     backend = DeviceSolverBackend(num_restarts=8)
     backend.CIRCUIT_STEPS = 2  # executing at scale is the point, not solving
     results = backend.try_solve_batch_circuit(
-        [(prep.num_vars, prep.clauses,
-          (prep.blaster.aig, prep.blaster.last_roots))],
+        [(prep.num_vars, prep.clauses, prep.aig_roots)],
         budget_seconds=10.0,
         size_caps=(4096, 1 << 24, 1 << 18),
     )
