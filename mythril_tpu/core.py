@@ -46,12 +46,21 @@ class MythrilDisassembler:
         self.contracts.append(contract)
         return contract
 
-    def load_from_solidity(self, solidity_files: List[str]):
-        from mythril_tpu.solidity.soliditycontract import get_contracts_from_file
+    def load_from_solidity(self, solidity_files: List[str],
+                           solc_version: Optional[str] = None,
+                           solc_args: Optional[List[str]] = None):
+        from mythril_tpu.solidity.soliditycontract import (
+            find_solc_version,
+            get_contracts_from_file,
+        )
 
+        solc_binary = (
+            find_solc_version(solc_version) if solc_version else None
+        )
         contracts = []
         for file in solidity_files:
-            contracts.extend(get_contracts_from_file(file))
+            contracts.extend(
+                get_contracts_from_file(file, solc_binary, solc_args))
         self.contracts.extend(contracts)
         return contracts
 

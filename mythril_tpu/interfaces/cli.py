@@ -140,6 +140,11 @@ def add_input_args(parser) -> None:
     parser.add_argument("-a", "--address", help="on-chain contract address")
     parser.add_argument("--bin-runtime", action="store_true",
                         help="treat -c/-f input as runtime (deployed) code")
+    parser.add_argument("--solv", metavar="VERSION",
+                        help="solc version to use (resolved as solc-vVERSION "
+                             "on PATH or in $SOLC_DIR; no network downloads)")
+    parser.add_argument("--solc-args",
+                        help="extra arguments passed through to solc")
     parser.add_argument("--rpc", help="custom RPC endpoint host:port")
     parser.add_argument("--rpctls", action="store_true", help="RPC over TLS")
     parser.add_argument("-v", "--verbose", type=int, default=2,
@@ -234,7 +239,14 @@ def _build_disassembler_and_load(parsed):
         disassembler.load_from_address(parsed.address)
     elif getattr(parsed, "solidity_files", None):
         try:
-            disassembler.load_from_solidity(parsed.solidity_files)
+            import shlex
+
+            disassembler.load_from_solidity(
+                parsed.solidity_files,
+                solc_version=getattr(parsed, "solv", None),
+                solc_args=shlex.split(
+                    getattr(parsed, "solc_args", None) or "") or None,
+            )
         except ImportError as error:
             raise CliError(f"solidity support unavailable: {error}")
     else:

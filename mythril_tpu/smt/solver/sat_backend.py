@@ -134,10 +134,66 @@ def solve_cnf(
                 0.05, timeout_seconds - (_time.monotonic() - start))
     lib = _get_native()
     if lib is not None:
-        return _solve_native(lib, num_vars, clauses, assumptions,
-                             timeout_seconds, conflict_budget)
-    return _solve_python(num_vars, clauses, assumptions, timeout_seconds,
-                         conflict_budget)
+        status, model = _solve_native(lib, num_vars, clauses, assumptions,
+                                      timeout_seconds, conflict_budget)
+    else:
+        status, model = _solve_python(num_vars, clauses, assumptions,
+                                      timeout_seconds, conflict_budget)
+    if status == UNSAT and _crosscheck_enabled():
+        status = _crosscheck_unsat(num_vars, clauses, assumptions,
+                                   timeout_seconds, conflict_budget)
+    return status, model
+
+
+def _crosscheck_enabled() -> bool:
+    return os.environ.get("MYTHRIL_TPU_UNSAT_CROSSCHECK", "") not in ("", "0")
+
+
+def _crosscheck_unsat(num_vars, clauses, assumptions, timeout_seconds,
+                      conflict_budget=0) -> str:
+    """Soundness net for UNSAT verdicts (SAT models are independently
+    validated at the frontend; UNSAT had no second opinion). Re-solve under
+    a random variable relabeling + clause shuffle — a search-order-dependent
+    CDCL bug that wrongly reports UNSAT is overwhelmingly unlikely to do so
+    again on the permuted instance. Disagreement degrades the verdict to
+    UNKNOWN (callers treat that as possibly-feasible) and logs loudly.
+    Opt-in via MYTHRIL_TPU_UNSAT_CROSSCHECK=1 — it doubles UNSAT cost."""
+    import random as _random
+
+    rng = _random.Random(num_vars * 1_000_003 + len(clauses))
+    perm = list(range(1, num_vars + 1))
+    rng.shuffle(perm)
+    relabel = {v: perm[v - 1] for v in range(1, num_vars + 1)}
+
+    def map_lit(lit: int) -> int:
+        return relabel[lit] if lit > 0 else -relabel[-lit]
+
+    shuffled = [tuple(map_lit(l) for l in clause) for clause in clauses]
+    rng.shuffle(shuffled)
+    mapped_assumptions = [map_lit(a) for a in assumptions]
+    # crosscheck runs CDCL-only (allow_device False by construction: this
+    # path is below the device dispatch) and never re-crosschecks. It is
+    # always bounded: the caller's timeout/conflict budget carries over,
+    # and an unbudgeted call still gets a 10 s ceiling
+    if not timeout_seconds and not conflict_budget:
+        timeout_seconds = 10.0
+    lib = _get_native()
+    if lib is not None:
+        second, _ = _solve_native(lib, num_vars, shuffled,
+                                  mapped_assumptions, timeout_seconds,
+                                  conflict_budget)
+    else:
+        second, _ = _solve_python(num_vars, shuffled, mapped_assumptions,
+                                  timeout_seconds, conflict_budget)
+    if second == SAT:
+        import logging
+
+        logging.getLogger(__name__).critical(
+            "UNSAT crosscheck DISAGREED: permuted instance is SAT "
+            "(%d vars, %d clauses) — degrading verdict to UNKNOWN",
+            num_vars, len(clauses))
+        return UNKNOWN
+    return UNSAT
 
 
 def _solve_native(lib, num_vars, clauses, assumptions, timeout_seconds,

@@ -34,12 +34,47 @@ def find_solc(solc_binary: Optional[str] = None) -> str:
     return binary
 
 
+def find_solc_version(version: str) -> str:
+    """Resolve a specific compiler version (reference `--solv`): looks for
+    `solc-vVERSION` on PATH and in $SOLC_DIR / ~/.mythril/solc. This
+    environment has no network, so nothing is downloaded — a missing
+    version is a clear error, not a fetch."""
+    name = f"solc-v{version.lstrip('v')}"
+    candidates = [shutil.which(name)]
+    for root in (os.environ.get("SOLC_DIR"),
+                 os.path.join(os.path.expanduser("~"), ".mythril", "solc")):
+        if root:
+            candidates.append(os.path.join(root, name))
+    for candidate in candidates:
+        if candidate and os.path.exists(candidate):
+            return candidate
+    raise ImportError(
+        f"solc {version} not found (looked for {name} on PATH and in "
+        "$SOLC_DIR; downloads are disabled in this environment)"
+    )
+
+
 def get_solc_json(file_path: str, solc_binary: Optional[str] = None,
                   solc_args: Optional[List[str]] = None) -> dict:
-    """Run `solc --standard-json` on one file; returns the parsed output."""
+    """Run `solc --standard-json` on one file; returns the parsed output.
+
+    solc rejects most CLI options in standard-json mode, so the common
+    compile flags (--optimize, --optimize-runs N) are translated into the
+    standard-json settings; path options pass through on the command line."""
     binary = find_solc(solc_binary)
     with open(file_path) as handle:
         source = handle.read()
+    optimizer: dict = {"enabled": False}
+    cli_args: List[str] = []
+    args_iter = iter(solc_args or [])
+    for arg in args_iter:
+        if arg == "--optimize":
+            optimizer["enabled"] = True
+        elif arg == "--optimize-runs":
+            optimizer["enabled"] = True
+            optimizer["runs"] = int(next(args_iter, 200))
+        else:
+            cli_args.append(arg)
     standard_input = {
         "language": "Solidity",
         "sources": {file_path: {"content": source}},
@@ -56,12 +91,11 @@ def get_solc_json(file_path: str, solc_binary: Optional[str] = None,
                     "": ["ast"],
                 }
             },
-            "optimizer": {"enabled": False},
+            "optimizer": optimizer,
         },
     }
     proc = subprocess.run(
-        [binary, "--standard-json", "--allow-paths", "."]
-        + (solc_args or []),
+        [binary, "--standard-json", "--allow-paths", "."] + cli_args,
         input=json.dumps(standard_input),
         capture_output=True, text=True,
     )

@@ -224,3 +224,17 @@ def test_read_storage_slot_math():
         disassembler.get_state_variable_from_storage("0xabc", ["mapping", "1"])
     with pytest.raises(ValueError):
         disassembler.get_state_variable_from_storage("0xabc", ["not-a-number"])
+
+
+def test_solv_version_resolution(tmp_path, monkeypatch):
+    """--solv resolves solc-vX.Y.Z from $SOLC_DIR without network
+    (reference supports versioned compilers via --solv)."""
+    from mythril_tpu.solidity.soliditycontract import find_solc_version
+
+    fake = tmp_path / "solc-v0.8.26"
+    fake.write_text("#!/bin/sh\n")
+    monkeypatch.setenv("SOLC_DIR", str(tmp_path))
+    assert find_solc_version("0.8.26") == str(fake)
+    assert find_solc_version("v0.8.26") == str(fake)
+    with pytest.raises(ImportError):
+        find_solc_version("0.4.11")

@@ -51,10 +51,13 @@ TESTS_NOT_RELEVANT = [
     "loop_stacklimit_1020",  # max_depth keeps us from looping to 1020
     "loop_stacklimit_1021",
 ]
+# the reference also skips these (evm_test.py:51); jumpi_at_the_end from
+# its list PASSES here and stays active. The remaining two expect OOG from
+# net-gas-metered SSTORE (EIP-2200 dirty/clean slot pricing), which neither
+# engine models — min-gas bounds use the flat SSTORE floor.
 TESTS_TO_RESOLVE = [
     "jumpTo1InstructionafterJump",
     "sstore_load_2",
-    "jumpi_at_the_end",
 ]
 IGNORED = set(
     TESTS_WITH_GAS_SUPPORT
