@@ -427,6 +427,10 @@ class LaserEVM:
             signal.global_state.world_state.constraints
         )
         new_global_state.transient_storage = signal.global_state.transient_storage
+        # an inner call executes in the SAME block as its caller
+        new_global_state.environment.block_number = (
+            signal.global_state.environment.block_number
+        )
         self._fire("transaction_start", signal.transaction, new_global_state)
         return [new_global_state]
 

@@ -15,7 +15,11 @@ from mythril_tpu.smt import symbol_factory
 
 
 def _setup_concrete_state_for_execution(laser_evm, transaction) -> None:
-    """Seed the worklist WITHOUT the symbolic actor constraint."""
+    """Seed the worklist WITHOUT the symbolic actor constraint. A concrete
+    transaction.block_number pins NUMBER (replayed transactions come from a
+    known block — this is what makes the BlockNumberDynamicJump*
+    conformance vectors executable, where the jump target derives from
+    NUMBER); inner frames inherit it in svm._start_inner_transaction."""
     global_state = transaction.initial_global_state()
     global_state.transaction_stack.append((transaction, None))
     global_state.world_state.transaction_sequence.append(transaction)
@@ -36,6 +40,7 @@ def execute_transaction(
     origin_address=None,
     code=None,
     track_gas: bool = False,
+    block_number=None,
 ):
     """Seed and run one concrete message call on every open world state."""
     if isinstance(callee_address, int):
@@ -65,6 +70,7 @@ def execute_transaction(
             origin=origin_address,
             code=tx_code,
             call_value=symbol_factory.BitVecVal(value, 256),
+            block_number=block_number,
         )
         _setup_concrete_state_for_execution(laser_evm, transaction)
     return laser_evm.exec(track_gas=track_gas)
@@ -81,6 +87,7 @@ def execute_message_call(
     value,
     code=None,
     track_gas=False,
+    block_number=None,
 ):
     """Reference-shaped alias (concolic.py:73) used by the VMTests harness."""
     return execute_transaction(
@@ -94,4 +101,5 @@ def execute_message_call(
         origin_address=origin_address,
         code=code,
         track_gas=track_gas,
+        block_number=block_number,
     )

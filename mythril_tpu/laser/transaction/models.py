@@ -111,6 +111,14 @@ class BaseTransaction:
 
     def initial_global_state_from_environment(self, environment, active_function):
         world_state = self.world_state
+        if self.block_number is not None:
+            # concrete replay (concolic/VMTests): NUMBER is pinned for this
+            # frame; inner frames inherit it in svm._start_inner_transaction
+            environment.block_number = (
+                self.block_number
+                if isinstance(self.block_number, BitVec)
+                else symbol_factory.BitVecVal(self.block_number, 256)
+            )
         global_state = GlobalState(
             world_state, environment,
             machine_state=MachineState(gas_limit=self.gas_limit),

@@ -70,9 +70,16 @@ def get_solc_json(file_path: str, solc_binary: Optional[str] = None,
     for arg in args_iter:
         if arg == "--optimize":
             optimizer["enabled"] = True
-        elif arg == "--optimize-runs":
+        elif arg == "--optimize-runs" or arg.startswith("--optimize-runs="):
             optimizer["enabled"] = True
-            optimizer["runs"] = int(next(args_iter, 200))
+            raw = (arg.split("=", 1)[1] if "=" in arg
+                   else next(args_iter, "200"))
+            try:
+                optimizer["runs"] = int(raw)
+            except ValueError:
+                raise SolcError(
+                    f"--optimize-runs expects a number, got {raw!r}"
+                ) from None
         else:
             cli_args.append(arg)
     standard_input = {

@@ -299,3 +299,32 @@ def test_unsat_crosscheck_differential(monkeypatch):
                 any((model[l] if l > 0 else not model[-l]) for l in clause)
                 for clause in clauses
             )
+
+
+def test_unsat_crosscheck_disagreement_degrades_to_unknown(monkeypatch):
+    """If the permuted re-solve disagrees (reports SAT where the first solve
+    said UNSAT), the verdict must degrade to UNKNOWN — the entire point of
+    the soundness net."""
+    from mythril_tpu.smt.solver import sat_backend
+
+    monkeypatch.setenv("MYTHRIL_TPU_UNSAT_CROSSCHECK", "1")
+    calls = {"n": 0}
+    real_native, real_python = sat_backend._solve_native, sat_backend._solve_python
+
+    def fake_native(lib, num_vars, clauses, assumptions, timeout, budget):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            return sat_backend.UNSAT, None
+        return sat_backend.SAT, [False] * (num_vars + 1)
+
+    def fake_python(num_vars, clauses, assumptions, timeout, budget=0):
+        return fake_native(None, num_vars, clauses, assumptions, timeout,
+                           budget)
+
+    monkeypatch.setattr(sat_backend, "_solve_native", fake_native)
+    monkeypatch.setattr(sat_backend, "_solve_python", fake_python)
+    status, model = sat_backend.solve_cnf(
+        2, [(1,), (-1,)], timeout_seconds=5.0, allow_device=False)
+    assert status == sat_backend.UNKNOWN
+    assert model is None
+    assert calls["n"] == 2

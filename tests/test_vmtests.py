@@ -33,19 +33,10 @@ TEST_TYPES = [
 
 # same documented gaps as the reference harness (evm_test.py:32-59)
 TESTS_WITH_GAS_SUPPORT = ["gas0", "gas1"]
-TESTS_WITH_BLOCK_NUMBER_SUPPORT = [
-    "BlockNumberDynamicJumpi0",
-    "BlockNumberDynamicJumpi1",
-    "BlockNumberDynamicJump0_jumpdest2",
-    "DynamicJumpPathologicalTest0",
-    "BlockNumberDynamicJumpifInsidePushWithJumpDest",
-    "BlockNumberDynamicJumpiAfterStop",
-    "BlockNumberDynamicJumpifInsidePushWithoutJumpDest",
-    "BlockNumberDynamicJump0_jumpdest0",
-    "BlockNumberDynamicJumpi1_jumpdest",
-    "BlockNumberDynamicJumpiOutsideBoundary",
-    "DynamicJumpJD_DependsOnJumps1",
-]
+# the reference skips all 11 BlockNumber*/DynamicJump* vectors (it leaves
+# NUMBER symbolic); here the concolic replay pins the vector's
+# currentNumber, so every one of them executes and passes
+TESTS_WITH_BLOCK_NUMBER_SUPPORT = []
 TESTS_WITH_LOG_SUPPORT = ["log1MemExp"]
 TESTS_NOT_RELEVANT = [
     "loop_stacklimit_1020",  # max_depth keeps us from looping to 1020
@@ -150,6 +141,7 @@ def test_vmtest(test_name, environment, pre_condition, action, gas_used,
         gas_price=int(action["gasPrice"], 16),
         value=int(action["value"], 16),
         track_gas=True,
+        block_number=int(environment["currentNumber"], 16),
     )
 
     if gas_used is not None and gas_used < int(
