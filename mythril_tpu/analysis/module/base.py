@@ -62,6 +62,8 @@ class DetectionModule:
     def execute(self, target, opcode: Optional[str] = None,
                 prehook: bool = True) -> Optional[List]:
         """target: GlobalState for CALLBACK modules, statespace for POST."""
+        from mythril_tpu.support import model as model_mod
+
         if self.entry_point == EntryPoint.CALLBACK:
             self.current_opcode = opcode
             self.is_prehook = prehook
@@ -71,9 +73,17 @@ class DetectionModule:
                 and self._cache_key(target) in self.cache
             ):
                 return None
-            result = self._analyze_state(target)
-        else:
-            result = self._analyze_statespace(target)
+        # inline detection-context flip (not the contextmanager): this is
+        # the engine's hottest path — every opcode x every callback module
+        previous_context = model_mod._in_detection_context
+        model_mod._in_detection_context = True
+        try:
+            if self.entry_point == EntryPoint.CALLBACK:
+                result = self._analyze_state(target)
+            else:
+                result = self._analyze_statespace(target)
+        finally:
+            model_mod._in_detection_context = previous_context
         if result:
             from mythril_tpu.support.args import args
 

@@ -1,122 +1,107 @@
 """TransactionOrderDependence — SWC-114 value transfer racing on storage
-(reference analysis/module/modules/transaction_order_dependence.py:140,
-POST entry).
+(reference analysis/module/modules/transaction_order_dependence.py:48-137).
 
-Heuristic (mirrors the reference): find CALL ops whose transfer value
-depends on a storage read, and SSTORE writes (in other transactions) that
-may alias the slot feeding that value — front-running the write changes
-what the call pays out."""
+Taint-annotation mechanism mirroring the reference: post-hooks on
+SLOAD/BALANCE annotate the pushed value with the reading transaction's
+sender; the annotation rides the engine's BitVec wrappers through any
+arithmetic. At a CALL whose transfer value carries the taint, the payout
+depends on balance/storage another transaction can change first —
+front-running the write changes what the call pays out. (A post-hoc
+statespace scan cannot detect this here: read-over-write elimination folds
+`SLOAD(slot)` of a just-written slot into the written expression, so no
+storage select survives in the value term.)"""
 
 import logging
 
 from mythril_tpu.analysis.module.base import DetectionModule, EntryPoint
-from mythril_tpu.analysis.report import Issue
-from mythril_tpu.analysis.solver import get_transaction_sequence
+from mythril_tpu.analysis.potential_issues import (
+    PotentialIssue,
+    get_potential_issues_annotation,
+)
 from mythril_tpu.analysis.swc_data import TX_ORDER_DEPENDENCE
-from mythril_tpu.smt import terms as _terms
-from mythril_tpu.smt.solver.frontend import SolverTimeOutException, UnsatError
+from mythril_tpu.laser.transaction.symbolic import ACTORS
+from mythril_tpu.smt import Or, symbol_factory
+from mythril_tpu.smt.solver.frontend import UnsatError
+from mythril_tpu.support.model import get_model
 
 log = logging.getLogger(__name__)
 
 
-def _storage_reads(term):
-    """Base-array storage selects inside a term."""
-    reads = []
-    for node in _terms.walk_terms([term]):
-        if node.op == "select":
-            base = node.children[0]
-            while base.op == "store":
-                base = base.children[0]
-            if base.op == "array" and str(base.params[0]).startswith("Storage"):
-                reads.append((base.params[0], node.children[1]))
-    return reads
+class BalanceAnnotation:
+    def __init__(self, caller):
+        self.caller = caller
+
+
+class StorageAnnotation:
+    def __init__(self, caller):
+        self.caller = caller
 
 
 class TxOrderDependence(DetectionModule):
     name = "tx_order_dependence"
     swc_id = TX_ORDER_DEPENDENCE
-    description = "The call value depends on storage writable by other transactions."
-    entry_point = EntryPoint.POST
+    description = "The call value depends on balance or storage writable by other transactions."
+    entry_point = EntryPoint.CALLBACK
+    pre_hooks = ["CALL"]
+    post_hooks = ["BALANCE", "SLOAD"]
 
-    def _analyze_statespace(self, statespace) -> list:
-        issues = []
-        # gather storage-dependent call values and sstore events
-        calls = []   # (state, instruction, reads)
-        writes = []  # (tx_id, slot_term)
-        for node in statespace.nodes.values():
-            for state in node.states:
-                instruction = state.get_current_instruction()
-                if instruction is None:
-                    continue
-                stack = (
-                    state.mstate_stack
-                    if hasattr(state, "mstate_stack")
-                    else state.mstate.stack
+    def _analyze_state(self, state):
+        if not self.is_prehook:
+            # post BALANCE/SLOAD: taint the pushed value with the sender
+            if state.mstate.stack:
+                value = state.mstate.stack[-1]
+                annotation = (
+                    BalanceAnnotation
+                    if self.current_opcode == "BALANCE"
+                    else StorageAnnotation
                 )
-                if instruction.opcode in ("CALL", "CALLCODE") and len(stack) >= 3:
-                    value = stack[-3]
-                    if value.symbolic:
-                        reads = _storage_reads(value.raw)
-                        if reads:
-                            calls.append((state, instruction, reads))
-                elif instruction.opcode == "SSTORE" and len(stack) >= 2:
-                    tx = state.transaction
-                    writes.append(
-                        (tx.id if tx else None, stack[-1].raw)
-                    )
-        seen = set()
-        for state, instruction, reads in calls:
-            tx = state.transaction
-            tx_id = tx.id if tx else None
-            racing = False
-            for write_tx, write_slot in writes:
-                if write_tx == tx_id:
-                    continue  # same transaction cannot be front-run
-                for _arr, read_slot in reads:
-                    alias = _terms.eq(write_slot, read_slot)
-                    if not (alias.is_const and alias.value is False):
-                        racing = True
-                        break
-                if racing:
-                    break
-            if not racing:
-                continue
-            key = (
-                instruction.address,
-                "0x" + state.environment.code.bytecode_hash.hex(),
+                if not value.get_annotations(annotation):
+                    value.annotate(annotation(state.environment.sender))
+            return []
+
+        value = state.mstate.stack[-3]
+        callers = [
+            a.caller
+            for annotation_type in (StorageAnnotation, BalanceAnnotation)
+            for a in value.get_annotations(annotation_type)[:1]
+        ]
+        if not callers:
+            return []
+        call_constraint = symbol_factory.Bool(False)
+        for caller in callers:
+            call_constraint = Or(call_constraint, ACTORS.attacker == caller)
+        constraints = [call_constraint]
+        try:
+            get_model(
+                state.world_state.constraints.get_all_constraints()
+                + constraints
             )
-            if key in seen or key in self.cache:
-                continue
-            try:
-                transaction_sequence = get_transaction_sequence(
-                    state, state.constraints
-                )
-            except (UnsatError, SolverTimeOutException, AttributeError):
-                continue
-            except Exception:
-                continue
-            seen.add(key)
-            issues.append(
-                Issue(
-                    contract=state.environment.active_account.contract_name,
-                    function_name=state.environment.active_function_name,
-                    address=instruction.address,
-                    swc_id=TX_ORDER_DEPENDENCE,
-                    title="Transaction Order Dependence",
-                    severity="Medium",
-                    bytecode=state.environment.code.bytecode,
-                    description_head=(
-                        "The value of the call is dependent on balance or "
-                        "storage write"
-                    ),
-                    description_tail=(
-                        "This can lead to race conditions. An attacker may be "
-                        "able to run a transaction after our transaction which "
-                        "can change the value of the call, e.g. by "
-                        "front-running a storage write that determines the "
-                        "amount paid out."
-                    ),
-                    transaction_sequence=transaction_sequence,
-                )
-            )
-        return issues
+        except UnsatError:
+            return []
+        except Exception:
+            return []
+        potential_issue = PotentialIssue(
+            contract=state.environment.active_account.contract_name,
+            function_name=state.environment.active_function_name,
+            address=state.get_current_instruction().address,
+            swc_id=TX_ORDER_DEPENDENCE,
+            title="Transaction Order Dependence",
+            severity="Medium",
+            bytecode=state.environment.code.bytecode,
+            description_head=(
+                "The value of the call is dependent on balance or "
+                "storage write"
+            ),
+            description_tail=(
+                "This can lead to race conditions. An attacker may be "
+                "able to run a transaction after our transaction which "
+                "can change the value of the call, e.g. by front-running "
+                "a storage write that determines the amount paid out."
+            ),
+            constraints=constraints,
+            detector=self,
+        )
+        get_potential_issues_annotation(state).potential_issues.append(
+            potential_issue
+        )
+        return []

@@ -30,19 +30,26 @@ def pretty_print_model(model) -> str:
 
 
 def get_transaction_sequence(global_state, constraints: Constraints) -> Dict:
-    """Solve constraints and concretize the tx sequence; raises UnsatError."""
+    """Solve constraints and concretize the tx sequence; raises UnsatError.
+
+    Runs in a detection context: an UNSAT here is a detection-critical "no
+    exploit" verdict (module predicates, potential-issue confirmation), so
+    get_model requests the permuted-instance crosscheck by default."""
+    from mythril_tpu.support.model import detection_context
+
     transaction_sequence = global_state.world_state.transaction_sequence
 
     tx_constraints, minimize = _set_minimisation_constraints(
         transaction_sequence,
         Constraints(list(constraints)),
     )
-    model = get_model(
-        tx_constraints.get_all_constraints()
-        if isinstance(tx_constraints, Constraints)
-        else tx_constraints,
-        minimize=minimize,
-    )
+    with detection_context():
+        model = get_model(
+            tx_constraints.get_all_constraints()
+            if isinstance(tx_constraints, Constraints)
+            else tx_constraints,
+            minimize=minimize,
+        )
 
     steps = []
     initial_accounts = {}

@@ -210,7 +210,7 @@ class MythrilAnalyzer:
                 "disable_mutation_pruner", "disable_dependency_pruning",
                 "enable_state_merging", "enable_summaries", "solver_backend",
                 "transaction_sequences", "beam_width",
-                "disable_coverage_strategy",
+                "disable_coverage_strategy", "jobs",
             ):
                 if hasattr(cmd_args, field) and getattr(cmd_args, field) is not None:
                     setattr(args, field, getattr(cmd_args, field))
@@ -229,53 +229,19 @@ class MythrilAnalyzer:
             module.reset_cache()
         stats = SolverStatistics()
         stats.enabled = True
-        all_issues: List[Issue] = []
-        exceptions: List[str] = []
         tx_count = transaction_count or args.transaction_count
-        for contract in self.contracts:
-            tx_id_manager.restart_counter()
-            from mythril_tpu.laser.function_managers import (
-                keccak_function_manager,
-            )
 
-            keccak_function_manager.reset()
-            contract_start = time.monotonic()
-            solver_before = stats.solver_time
-            device_before = stats.device_stats()
-            dynloader = None
-            if self.eth is not None:
-                from mythril_tpu.support.loader import DynLoader
-
-                dynloader = DynLoader(self.eth)
-            try:
-                sym = SymExecWrapper(
-                    contract,
-                    self.address,
-                    self.strategy,
-                    dynloader=dynloader,
-                    max_depth=args.max_depth,
-                    execution_timeout=args.execution_timeout,
-                    loop_bound=args.loop_bound,
-                    create_timeout=args.create_timeout,
-                    transaction_count=tx_count,
-                    modules=modules,
-                    compulsory_statespace=False,
-                )
-                issues = fire_lasers(sym, white_list=modules)
-            except KeyboardInterrupt:
-                log.critical("keyboard interrupt: retrieving partial results")
-                issues = retrieve_callback_issues(modules)
-            except Exception:
-                log.exception("exception during analysis of %s", contract.name)
-                exceptions.append(traceback.format_exc())
-                issues = retrieve_callback_issues(modules)
-            for issue in issues:
-                issue.add_code_info(contract)
-                issue.resolve_function_name(_signature_db())
-            log.info(str(stats))
-            log.info(self._phase_split(contract.name, contract_start,
-                                       solver_before, device_before, stats))
-            all_issues.extend(issues)
+        if args.jobs > 1 and len(self.contracts) > 1 and self.eth is None:
+            all_issues, exceptions = self._fire_lasers_parallel(
+                modules, tx_count)
+        else:
+            all_issues = []
+            exceptions = []
+            for contract in self.contracts:
+                issues, contract_exceptions = self._analyze_one_contract(
+                    contract, modules, tx_count, stats=stats)
+                all_issues.extend(issues)
+                exceptions.extend(contract_exceptions)
 
         report = Report(
             contracts=self.contracts,
@@ -284,6 +250,93 @@ class MythrilAnalyzer:
         for issue in all_issues:
             report.append_issue(issue)
         return report
+
+    def _analyze_one_contract(self, contract, modules, tx_count, stats=None):
+        """Symbolic execution + modules for ONE contract (the loop body the
+        corpus fan-out distributes). Returns (issues, exceptions)."""
+        exceptions: List[str] = []
+        tx_id_manager.restart_counter()
+        from mythril_tpu.laser.function_managers import (
+            keccak_function_manager,
+        )
+
+        keccak_function_manager.reset()
+        contract_start = time.monotonic()
+        solver_before = stats.solver_time if stats else 0.0
+        device_before = stats.device_stats() if stats else {}
+        dynloader = None
+        if self.eth is not None:
+            from mythril_tpu.support.loader import DynLoader
+
+            dynloader = DynLoader(self.eth)
+        try:
+            sym = SymExecWrapper(
+                contract,
+                self.address,
+                self.strategy,
+                dynloader=dynloader,
+                max_depth=args.max_depth,
+                execution_timeout=args.execution_timeout,
+                loop_bound=args.loop_bound,
+                create_timeout=args.create_timeout,
+                transaction_count=tx_count,
+                modules=modules,
+                compulsory_statespace=False,
+            )
+            issues = fire_lasers(sym, white_list=modules)
+        except KeyboardInterrupt:
+            log.critical("keyboard interrupt: retrieving partial results")
+            issues = retrieve_callback_issues(modules)
+        except Exception:
+            log.exception("exception during analysis of %s", contract.name)
+            exceptions.append(traceback.format_exc())
+            issues = retrieve_callback_issues(modules)
+        for issue in issues:
+            issue.add_code_info(contract)
+            issue.resolve_function_name(_signature_db())
+        if stats is not None:
+            log.info(str(stats))
+            log.info(self._phase_split(contract.name, contract_start,
+                                       solver_before, device_before, stats))
+        return issues, exceptions
+
+    def _fire_lasers_parallel(self, modules, tx_count):
+        """Corpus-level parallelism (reference mythril_analyzer.py:150 is
+        the stated fan-out point; BASELINE config 5): independent contracts
+        analyzed in -j worker PROCESSES. Process isolation is the correct
+        boundary — the engine's process-global state (term intern table,
+        shared blaster/AIG, model caches, keccak manager, module
+        singletons) makes in-process threading unsound and would serialize
+        on the GIL anyway. Spawn (not fork): the parent may hold a jax
+        runtime whose threads a fork would deadlock."""
+        import multiprocessing as mp
+
+        workers = min(args.jobs, len(self.contracts))
+        payloads = [
+            (contract, self.address, self.strategy, modules, tx_count,
+             dict(args.__dict__))
+            for contract in self.contracts
+        ]
+        context = mp.get_context("spawn")
+        all_issues: List[Issue] = []
+        exceptions: List[str] = []
+        try:
+            with context.Pool(processes=workers) as pool:
+                for issues, contract_exceptions in pool.map(
+                    _corpus_worker, payloads
+                ):
+                    all_issues.extend(issues)
+                    exceptions.extend(contract_exceptions)
+        except Exception:
+            log.exception(
+                "parallel corpus analysis failed; falling back to sequential")
+            all_issues, exceptions = [], []
+            for contract in self.contracts:
+                issues, contract_exceptions = self._analyze_one_contract(
+                    contract, modules, tx_count)
+                all_issues.extend(issues)
+                exceptions.extend(contract_exceptions)
+        return all_issues, exceptions
 
     @staticmethod
     def _phase_split(name, contract_start, solver_before, device_before,
@@ -345,6 +398,31 @@ class MythrilAnalyzer:
             compulsory_statespace=True,
         )
         return generate_graph(sym, physics=enable_physics)
+
+
+def _corpus_worker(payload):
+    """Spawn-process entry for one contract of a parallel corpus run.
+
+    Rebuilds the args singleton from the parent's snapshot (spawn starts
+    from a fresh interpreter), resets the per-process module/solver state,
+    and runs the standard single-contract path. Issues are plain data and
+    pickle back to the parent."""
+    contract, address, strategy, modules, tx_count, args_state = payload
+    args.__dict__.update(args_state)
+    args.jobs = 1  # workers never re-fan-out
+    from mythril_tpu.analysis.module import ModuleLoader
+
+    for module in ModuleLoader().get_detection_modules():
+        module.reset_module()
+        module.reset_cache()
+    stats = SolverStatistics()
+    stats.enabled = True
+    disassembler = MythrilDisassembler()
+    disassembler.contracts.append(contract)
+    analyzer = MythrilAnalyzer(disassembler, strategy=strategy,
+                               address=address)
+    return analyzer._analyze_one_contract(contract, modules, tx_count,
+                                          stats=stats)
 
 
 def _signature_db():

@@ -9,6 +9,7 @@ import json
 import logging
 import os
 import sys
+from typing import List
 
 from mythril_tpu.version import __version__
 
@@ -135,8 +136,9 @@ def add_input_args(parser) -> None:
     parser.add_argument("solidity_files", nargs="*",
                         help="solidity files (requires solc)")
     parser.add_argument("-c", "--code", help="hex bytecode string")
-    parser.add_argument("-f", "--codefile",
-                        help="file containing hex bytecode")
+    parser.add_argument("-f", "--codefile", action="append",
+                        help="file containing hex bytecode (repeatable: "
+                             "each -f adds one contract to the run)")
     parser.add_argument("-a", "--address", help="on-chain contract address")
     parser.add_argument("--bin-runtime", action="store_true",
                         help="treat -c/-f input as runtime (deployed) code")
@@ -170,6 +172,9 @@ def add_analysis_args(parser) -> None:
     parser.add_argument("--pruning-factor", type=float, default=None)
     parser.add_argument("--unconstrained-storage", action="store_true")
     parser.add_argument("--parallel-solving", action="store_true")
+    parser.add_argument("--jobs", type=int, default=1,
+                        help="analyze contracts in N parallel worker "
+                             "processes (corpus-level parallelism)")
     parser.add_argument("--solver-log", help="directory for SMT2 query dumps")
     parser.add_argument("--solver-backend", default="cpu",
                         choices=["cpu", "tpu"],
@@ -211,12 +216,16 @@ def configure_logging(verbosity: int) -> None:
     )
 
 
-def load_code(parsed) -> str:
+def load_code(parsed) -> List[str]:
+    """Hex blobs to analyze, one per contract (repeatable -f)."""
     if parsed.code:
-        return parsed.code
+        return [parsed.code]
     if parsed.codefile:
-        with open(parsed.codefile) as handle:
-            return handle.read().strip()
+        blobs = []
+        for path in parsed.codefile:
+            with open(path) as handle:
+                blobs.append(handle.read().strip())
+        return blobs
     raise CliError(
         "no input: provide -c <hex>, -f <file>, -a <address>, or a .sol file"
     )
@@ -250,9 +259,10 @@ def _build_disassembler_and_load(parsed):
         except ImportError as error:
             raise CliError(f"solidity support unavailable: {error}")
     else:
-        disassembler.load_from_bytecode(
-            load_code(parsed), bin_runtime=getattr(parsed, "bin_runtime", False)
-        )
+        for blob in load_code(parsed):
+            disassembler.load_from_bytecode(
+                blob, bin_runtime=getattr(parsed, "bin_runtime", False)
+            )
     return disassembler
 
 

@@ -10,7 +10,9 @@ Literal encoding (standard AIG): variable v -> literals 2v (pos) / 2v+1
 Bit vectors are LSB-first literal lists. CNF via Tseitin (3 clauses/gate).
 """
 
-from typing import Dict, List, Tuple
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
 
 from mythril_tpu.smt.terms import BOOL, Term
 
@@ -21,27 +23,155 @@ TRUE_LIT = 1
 _AIG_UID = 0
 
 
+class CNF:
+    """Flat CNF: DIMACS literals in one int32 array + int64 clause offsets.
+
+    The numpy buffers go straight to the C++ CDCL via pointer (no per-lit
+    marshalling) and to the vectorized clause checker; iteration yields the
+    legacy tuple-of-ints view for the pure-Python fallback paths."""
+
+    __slots__ = ("lits", "offsets", "num_clauses", "has_empty")
+
+    def __init__(self, lits, offsets, num_clauses: int, has_empty: bool):
+        self.lits = lits
+        self.offsets = offsets
+        self.num_clauses = num_clauses
+        self.has_empty = has_empty
+
+    def __len__(self) -> int:
+        return self.num_clauses
+
+    def __iter__(self) -> Iterator[Tuple[int, ...]]:
+        lits, offsets = self.lits, self.offsets
+        for c in range(self.num_clauses):
+            yield tuple(int(l) for l in lits[offsets[c]:offsets[c + 1]])
+
+    @classmethod
+    def from_clauses(cls, clauses) -> "CNF":
+        offsets = np.zeros(len(clauses) + 1, dtype=np.int64)
+        flat: List[int] = []
+        has_empty = False
+        for i, clause in enumerate(clauses):
+            if not clause:
+                has_empty = True
+            flat.extend(clause)
+            offsets[i + 1] = len(flat)
+        return cls(np.array(flat, dtype=np.int32), offsets, len(clauses),
+                   has_empty)
+
+
+class DenseMap:
+    """global AIG var -> dense CNF var, over a numpy column (0 = absent).
+    Drop-in for the dict the Python exporter used (.get protocol)."""
+
+    __slots__ = ("arr",)
+
+    def __init__(self, arr):
+        self.arr = arr
+
+    def get(self, var: int, default=None):
+        if 0 <= var < len(self.arr):
+            dense = int(self.arr[var])
+            if dense:
+                return dense
+        return default
+
+    def __getitem__(self, var: int) -> int:
+        dense = self.get(var)
+        if dense is None:
+            raise KeyError(var)
+        return dense
+
+    def __len__(self) -> int:
+        return int(np.count_nonzero(self.arr))
+
+
+class _GateView:
+    """Dict-like read view of the AIG's flat gate arrays (compat shim for
+    the levelizer and tests; no per-gate dict is materialized)."""
+
+    __slots__ = ("_aig",)
+
+    def __init__(self, aig: "AIG"):
+        self._aig = aig
+
+    def get(self, var: int, default=None):
+        lhs = self._aig.gate_lhs
+        if 0 <= var < len(lhs) and lhs[var] >= 0:
+            return (lhs[var], self._aig.gate_rhs[var])
+        return default
+
+    def __getitem__(self, var: int) -> Tuple[int, int]:
+        gate = self.get(var)
+        if gate is None:
+            raise KeyError(var)
+        return gate
+
+    def items(self):
+        lhs, rhs = self._aig.gate_lhs, self._aig.gate_rhs
+        for var in range(1, len(lhs)):
+            if lhs[var] >= 0:
+                yield var, (lhs[var], rhs[var])
+
+
 class AIG:
     """And-Inverter Graph with structural hashing. Append-only: a root
     literal's cone never changes once created, so (aig.uid, roots) is a
-    sound cache key for packed/blasted artifacts."""
+    sound cache key for packed/blasted artifacts.
+
+    Gates live in flat per-var lists (gate_lhs/gate_rhs, -1 = circuit
+    input), mirrored incrementally into numpy arrays so cone extraction and
+    Tseitin export run in native/sat.cpp instead of per-node Python."""
 
     def __init__(self):
         global _AIG_UID
         _AIG_UID += 1
         self.uid = _AIG_UID
         self.num_vars = 0          # var 0 reserved for constant TRUE/FALSE
-        # gate output var -> (lhs_lit, rhs_lit); insertion-ordered, so it
-        # doubles as the creation-order gate list
-        self.gate_of_var: Dict[int, Tuple[int, int]] = {}
+        self.gate_lhs: List[int] = [-1]   # per var: defining gate's inputs
+        self.gate_rhs: List[int] = [-1]   # (-1 for circuit inputs / const)
         self._strash: Dict[Tuple[int, int], int] = {}
+        self._np_lhs: Optional[np.ndarray] = None
+        self._np_rhs: Optional[np.ndarray] = None
+        self._np_count = 0  # entries already mirrored into the numpy arrays
+
+    @property
+    def gate_of_var(self) -> _GateView:
+        return _GateView(self)
 
     def new_var(self) -> int:
         self.num_vars += 1
+        self.gate_lhs.append(-1)
+        self.gate_rhs.append(-1)
         return self.num_vars
 
     def lit_of_var(self, var: int, negated: bool = False) -> int:
         return 2 * var + (1 if negated else 0)
+
+    def gate_arrays(self) -> Tuple[np.ndarray, np.ndarray]:
+        """int32 views of the gate table, synced to the current watermark
+        (only the tail appended since the last call is converted)."""
+        n = self.num_vars + 1
+        if self._np_lhs is None or len(self._np_lhs) < n:
+            # capacity-doubling growth: a concatenate-per-sync would
+            # re-copy the whole mirrored prefix on every blast call
+            # (quadratic over an analyze run on the shared global AIG)
+            capacity = 1024
+            while capacity < n:
+                capacity *= 2
+            new_lhs = np.empty(capacity, dtype=np.int32)
+            new_rhs = np.empty(capacity, dtype=np.int32)
+            if self._np_lhs is not None and self._np_count:
+                new_lhs[:self._np_count] = self._np_lhs[:self._np_count]
+                new_rhs[:self._np_count] = self._np_rhs[:self._np_count]
+            else:
+                self._np_count = 0
+            self._np_lhs, self._np_rhs = new_lhs, new_rhs
+        if self._np_count < n:
+            self._np_lhs[self._np_count:n] = self.gate_lhs[self._np_count:n]
+            self._np_rhs[self._np_count:n] = self.gate_rhs[self._np_count:n]
+            self._np_count = n
+        return self._np_lhs[:n], self._np_rhs[:n]
 
     def and_gate(self, a: int, b: int) -> int:
         if a > b:
@@ -59,7 +189,8 @@ class AIG:
         if hit is not None:
             return hit
         var = self.new_var()
-        self.gate_of_var[var] = (a, b)
+        self.gate_lhs[var] = a
+        self.gate_rhs[var] = b
         lit = 2 * var
         self._strash[key] = lit
         return lit
@@ -86,21 +217,71 @@ class AIG:
         The cone's variables are renumbered into a DENSE 1..N space — the
         AIG is shared across problems (frontend get_global_blaster), and a
         CNF in global numbering would make every solve pay O(all vars ever
-        blasted). Returns (num_dense_vars, clauses, dense_of_global) where
-        clauses use DIMACS-signed DENSE literals.
-        """
+        blasted). Returns (num_dense_vars, cnf, dense_of_global) where `cnf`
+        is a CNF of DIMACS-signed DENSE literals and dense_of_global a
+        DenseMap. Cone extraction + emission run in native/sat.cpp when the
+        library is available (the pure-Python exporter dominated
+        heavy-contract wall time); the Python path below is the fallback
+        and the differential reference for it (tests/test_bitblast.py)."""
+        native = self._to_cnf_native(roots, defined)
+        if native is not None:
+            return native
+        return self._to_cnf_python(roots, defined)
+
+    def _to_cnf_native(self, roots, defined):
+        import ctypes
+
+        from mythril_tpu.smt.solver import sat_backend
+
+        lib = sat_backend.get_native_lib()
+        if lib is None:
+            return None
+        i32p = ctypes.POINTER(ctypes.c_int)
+        i64p = ctypes.POINTER(ctypes.c_longlong)
+        u8p = ctypes.POINTER(ctypes.c_ubyte)
+
+        def p32(arr):
+            return arr.ctypes.data_as(i32p)
+
+        lhs, rhs = self.gate_arrays()
+        seeds = np.array(
+            [r for r in list(roots) + list(defined) if (r >> 1) != 0],
+            dtype=np.int32,
+        )
+        needed = np.empty(self.num_vars + 1, dtype=np.uint8)
+        counts = np.zeros(2, dtype=np.int64)
+        lib.aig_cone(self.num_vars, p32(lhs), p32(rhs), p32(seeds),
+                     len(seeds), needed.ctypes.data_as(u8p),
+                     counts.ctypes.data_as(i64p))
+        gates = int(counts[0])
+        roots_arr = np.asarray(list(roots), dtype=np.int32)
+        lits = np.empty(7 * gates + len(roots_arr), dtype=np.int32)
+        offsets = np.empty(3 * gates + len(roots_arr) + 1, dtype=np.int64)
+        dense_arr = np.empty(self.num_vars + 1, dtype=np.int32)
+        meta = np.zeros(3, dtype=np.int64)
+        n_lits = lib.aig_emit_cnf(
+            self.num_vars, p32(lhs), p32(rhs), needed.ctypes.data_as(u8p),
+            p32(roots_arr), len(roots_arr), p32(dense_arr), p32(lits),
+            offsets.ctypes.data_as(i64p), meta.ctypes.data_as(i64p))
+        num_clauses = int(meta[1])
+        cnf = CNF(lits[:n_lits], offsets[:num_clauses + 1], num_clauses,
+                  bool(meta[2]))
+        return int(meta[0]), cnf, DenseMap(dense_arr)
+
+    def _to_cnf_python(self, roots, defined):
         clauses: List[Tuple[int, ...]] = []
 
-        # find reachable gates (gate_of_var is maintained incrementally so a
-        # small cone never pays for the whole shared AIG)
+        # find reachable gates (the gate table is maintained incrementally
+        # so a small cone never pays for the whole shared AIG)
         needed = set()
+        gate_of_var = self.gate_of_var
         stack = [r >> 1 for r in list(roots) + list(defined) if r >> 1 != 0]
         while stack:
             var = stack.pop()
             if var in needed:
                 continue
             needed.add(var)
-            gate = self.gate_of_var.get(var)
+            gate = gate_of_var.get(var)
             if gate is not None:
                 for lit in gate:
                     if lit >> 1 != 0:
@@ -113,7 +294,7 @@ class AIG:
             return -var if lit & 1 else var
 
         for var in sorted(needed):
-            gate = self.gate_of_var.get(var)
+            gate = gate_of_var.get(var)
             if gate is None:
                 continue  # circuit input
             lhs, rhs = gate
@@ -129,7 +310,10 @@ class AIG:
                 continue
             else:
                 clauses.append((dimacs(root),))
-        return len(dense), clauses, dense
+        dense_arr = np.zeros(self.num_vars + 1, dtype=np.int32)
+        for var, dvar in dense.items():
+            dense_arr[var] = dvar
+        return len(dense), CNF.from_clauses(clauses), DenseMap(dense_arr)
 
 
 class Blaster:

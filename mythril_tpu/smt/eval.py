@@ -42,6 +42,30 @@ def evaluate_many(terms_list, assignment: Dict):
     return [values[id(t)] for t in terms_list]
 
 
+def evaluate_shared(term: Term, assignment: Dict, values: Dict) -> object:
+    """evaluate() with a caller-owned node cache, so a sequence of
+    constraints sharing one path-prefix cone (the common case: model
+    validation, quick-sat probes) is walked once, not once per constraint —
+    while keeping per-constraint early exit. `values` must only be reused
+    with the SAME assignment."""
+    hit = values.get(id(term), values)
+    if hit is not values:
+        return hit
+    stack = [(term, False)]
+    while stack:
+        node, expanded = stack.pop()
+        if id(node) in values:
+            continue
+        if expanded:
+            values[id(node)] = _eval_node(node, values, assignment)
+        else:
+            stack.append((node, True))
+            for child in node.children:
+                if id(child) not in values:
+                    stack.append((child, False))
+    return values[id(term)]
+
+
 def _eval_node(node: Term, values: Dict[int, object], assignment: Dict):
     op = node.op
     if node.is_const and op != "karray":

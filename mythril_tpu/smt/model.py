@@ -39,11 +39,17 @@ class Model:
         return result
 
     def satisfies(self, constraints) -> bool:
-        """Check this model against a constraint list (quick-sat probe)."""
+        """Check this model against a constraint list (quick-sat probe).
+        One shared node cache across the list: sibling constraints share
+        their path-prefix cone, which the per-constraint evaluate() used to
+        re-walk (a top hotspot on heavy contracts)."""
+        from mythril_tpu.smt.eval import evaluate_shared
+
+        values: Dict = {}
         try:
             for constraint in constraints:
                 raw = constraint.raw if isinstance(constraint, Expression) else constraint
-                if evaluate(raw, self.assignment) is not True:
+                if evaluate_shared(raw, self.assignment, values) is not True:
                     return False
             return True
         except NotImplementedError:

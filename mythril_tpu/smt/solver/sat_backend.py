@@ -57,6 +57,29 @@ def _compile_native() -> Optional[ctypes.CDLL]:
         ctypes.c_longlong,
         ctypes.POINTER(ctypes.c_byte),
     ]
+    lib.aig_cone.restype = None
+    lib.aig_cone.argtypes = [
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int),
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_ubyte),
+        ctypes.POINTER(ctypes.c_longlong),
+    ]
+    lib.aig_emit_cnf.restype = ctypes.c_longlong
+    lib.aig_emit_cnf.argtypes = [
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_ubyte),
+        ctypes.POINTER(ctypes.c_int),
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_longlong),
+        ctypes.POINTER(ctypes.c_longlong),
+    ]
     return lib
 
 
@@ -72,6 +95,12 @@ def _get_native():
     return _lib
 
 
+def get_native_lib():
+    """The compiled native library (or None) — also hosts the AIG cone/
+    Tseitin exporters used by smt/bitblast.py."""
+    return _get_native()
+
+
 def solve_cnf(
     num_vars: int,
     clauses: Sequence[Tuple[int, ...]],
@@ -80,6 +109,7 @@ def solve_cnf(
     conflict_budget: int = 0,
     allow_device: bool = True,
     aig_roots=None,
+    crosscheck: bool = False,
 ) -> Tuple[str, Optional[List[bool]]]:
     """Solve CNF with DIMACS-signed literals.
 
@@ -100,14 +130,24 @@ def solve_cnf(
         start = _time.monotonic()
         # Local search cannot prove UNSAT, and feasibility queries are
         # mostly UNSAT: let a conflict-budgeted CDCL probe settle the easy
-        # ones first; only queries it can't crack go to the device.
-        probe_status, probe_model = solve_cnf(
-            num_vars, clauses, assumptions,
-            timeout_seconds=min(0.5, timeout_seconds or 0.5),
-            conflict_budget=20000,
-        )
-        if probe_status != UNKNOWN:
-            return probe_status, probe_model
+        # ones first; only queries it can't crack go to the device. Skip
+        # the probe on mega-instances (multiplier confirms, ~10 s solves):
+        # 20k conflicts never settles those, and the wasted half-second
+        # pushed near-deadline SAT verdicts into timeout on the tpu path
+        # while the cpu path found them.
+        if len(clauses) <= 200_000:
+            # forward `crosscheck`: a probe-settled UNSAT is still a
+            # detection verdict and must get its second opinion — without
+            # this the tpu path silently bypassed the crosscheck for
+            # exactly the small UNSAT queries the probe settles
+            probe_status, probe_model = solve_cnf(
+                num_vars, clauses, assumptions,
+                timeout_seconds=min(0.5, timeout_seconds or 0.5),
+                conflict_budget=20000,
+                crosscheck=crosscheck,
+            )
+            if probe_status != UNKNOWN:
+                return probe_status, probe_model
         try:
             from mythril_tpu.tpu.backend import get_device_backend
 
@@ -139,14 +179,22 @@ def solve_cnf(
     else:
         status, model = _solve_python(num_vars, clauses, assumptions,
                                       timeout_seconds, conflict_budget)
-    if status == UNSAT and _crosscheck_enabled():
+    if status == UNSAT and (crosscheck or _crosscheck_enabled()):
         status = _crosscheck_unsat(num_vars, clauses, assumptions,
                                    timeout_seconds, conflict_budget)
     return status, model
 
 
 def _crosscheck_enabled() -> bool:
+    """Global force-enable (the CI sweep runs the whole suite with it on).
+    Detection-path crosschecking is on by DEFAULT via the `crosscheck`
+    parameter (support/model.py detection_context); this env var extends it
+    to every solve (=1) or force-disables nothing here (=0 is handled by
+    the caller's _crosscheck_wanted)."""
     return os.environ.get("MYTHRIL_TPU_UNSAT_CROSSCHECK", "") not in ("", "0")
+
+
+CROSSCHECK_CLAUSE_CAP = 150_000
 
 
 def _crosscheck_unsat(num_vars, clauses, assumptions, timeout_seconds,
@@ -157,7 +205,13 @@ def _crosscheck_unsat(num_vars, clauses, assumptions, timeout_seconds,
     CDCL bug that wrongly reports UNSAT is overwhelmingly unlikely to do so
     again on the permuted instance. Disagreement degrades the verdict to
     UNKNOWN (callers treat that as possibly-feasible) and logs loudly.
-    Opt-in via MYTHRIL_TPU_UNSAT_CROSSCHECK=1 — it doubles UNSAT cost."""
+    On by default for detection-path verdicts (support/model.py);
+    MYTHRIL_TPU_UNSAT_CROSSCHECK=1 extends it to every solve. Bounded two
+    ways: instances past CROSSCHECK_CLAUSE_CAP are skipped (a permuted
+    multiplier cone inside the cap budget is almost always UNKNOWN — pure
+    cost, no information) and the re-solve itself is capped at 3 s."""
+    if len(clauses) > CROSSCHECK_CLAUSE_CAP:
+        return UNSAT
     import random as _random
 
     rng = _random.Random(num_vars * 1_000_003 + len(clauses))
@@ -168,15 +222,48 @@ def _crosscheck_unsat(num_vars, clauses, assumptions, timeout_seconds,
     def map_lit(lit: int) -> int:
         return relabel[lit] if lit > 0 else -relabel[-lit]
 
-    shuffled = [tuple(map_lit(l) for l in clause) for clause in clauses]
-    rng.shuffle(shuffled)
+    if hasattr(clauses, "lits"):
+        # CNF buffers: vectorized relabel + clause-order shuffle (the
+        # tuple-by-tuple path burned seconds per crosscheck on 100k-clause
+        # instances)
+        import numpy as np
+
+        from mythril_tpu.smt.bitblast import CNF
+
+        perm_arr = np.empty(num_vars + 1, dtype=np.int64)
+        perm_arr[0] = 0
+        perm_arr[1:] = perm
+        lits = clauses.lits
+        relabeled = np.where(
+            lits > 0, perm_arr[np.abs(lits)], -perm_arr[np.abs(lits)]
+        ).astype(np.int32)
+        offsets = clauses.offsets
+        order = np.arange(len(clauses))
+        rng.shuffle(order)
+        lengths = (offsets[1:] - offsets[:-1])[order]
+        new_offsets = np.zeros(len(clauses) + 1, dtype=np.int64)
+        np.cumsum(lengths, out=new_offsets[1:])
+        # ragged gather of source literal indices in shuffled clause order:
+        # position i maps to src_start[clause(i)] + (i - dst_start[clause(i)])
+        total = int(new_offsets[-1])
+        src_starts = offsets[:-1][order]
+        gather = (
+            np.arange(total, dtype=np.int64)
+            + np.repeat(src_starts - new_offsets[:-1], lengths)
+        )
+        shuffled = CNF(relabeled[gather], new_offsets, len(clauses),
+                       clauses.has_empty)
+    else:
+        shuffled = [tuple(map_lit(l) for l in clause) for clause in clauses]
+        rng.shuffle(shuffled)
     mapped_assumptions = [map_lit(a) for a in assumptions]
     # crosscheck runs CDCL-only (allow_device False by construction: this
-    # path is below the device dispatch) and never re-crosschecks. It is
-    # always bounded: the caller's timeout/conflict budget carries over,
-    # and an unbudgeted call still gets a 10 s ceiling
-    if not timeout_seconds and not conflict_budget:
-        timeout_seconds = 10.0
+    # path is below the device dispatch) and never re-crosschecks. Always
+    # bounded: the caller's timeout carries over but is capped at 3 s —
+    # the second opinion must not double detection-path wall on heavy
+    # cones (an inconclusive timeout keeps the original UNSAT verdict:
+    # crosscheck can only DEGRADE a verdict on positive disagreement)
+    timeout_seconds = min(timeout_seconds or 3.0, 3.0)
     lib = _get_native()
     if lib is not None:
         second, _ = _solve_native(lib, num_vars, shuffled,
@@ -198,17 +285,29 @@ def _crosscheck_unsat(num_vars, clauses, assumptions, timeout_seconds,
 
 def _solve_native(lib, num_vars, clauses, assumptions, timeout_seconds,
                   conflict_budget):
-    flat: List[int] = []
-    offsets: List[int] = [0]
-    for clause in clauses:
-        flat.extend(clause)
-        offsets.append(len(flat))
-    lits_arr = (ctypes.c_int * max(len(flat), 1))(*flat)
-    offs_arr = (ctypes.c_longlong * len(offsets))(*offsets)
+    num_clauses = len(clauses)
+    if hasattr(clauses, "lits"):
+        # CNF buffers (smt/bitblast.py): hand the numpy storage straight to
+        # the C ABI — per-literal Python marshalling was a top-2 hotspot on
+        # heavy contracts (round-4 profile: ~37 s of ether_send's wall)
+        import numpy as np
+
+        lits_np = np.ascontiguousarray(clauses.lits, dtype=np.int32)
+        offs_np = np.ascontiguousarray(clauses.offsets, dtype=np.int64)
+        lits_arr = lits_np.ctypes.data_as(ctypes.POINTER(ctypes.c_int))
+        offs_arr = offs_np.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong))
+    else:
+        flat: List[int] = []
+        offsets: List[int] = [0]
+        for clause in clauses:
+            flat.extend(clause)
+            offsets.append(len(flat))
+        lits_arr = (ctypes.c_int * max(len(flat), 1))(*flat)
+        offs_arr = (ctypes.c_longlong * len(offsets))(*offsets)
     assume_arr = (ctypes.c_int * max(len(assumptions), 1))(*assumptions)
     model_arr = (ctypes.c_byte * (num_vars + 1))()
     status = lib.sat_solve(
-        num_vars, lits_arr, offs_arr, len(clauses), assume_arr,
+        num_vars, lits_arr, offs_arr, num_clauses, assume_arr,
         len(assumptions), float(timeout_seconds), int(conflict_budget),
         model_arr,
     )

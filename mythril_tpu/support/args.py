@@ -31,6 +31,7 @@ class _Args:
         self.solver_backend = "cpu"            # cpu | tpu (shadowed by cpu)
         self.beam_width = 8                    # --beam-search WIDTH
         self.transaction_sequences = None      # e.g. "[[0xa9059cbb],[-1]]"
+        self.jobs = 1                          # corpus-parallel workers (-j)
 
     def reset(self):
         self.__init__()

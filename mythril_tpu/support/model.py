@@ -11,8 +11,10 @@ This is also the designed backend seam: `args.solver_backend` selects the
 batched TPU solver for eligible queries (with the CPU CDCL as oracle).
 """
 
+import os
 import time
 from collections import OrderedDict, deque
+from contextlib import contextmanager
 from typing import Iterable, List, Optional
 
 from mythril_tpu.smt.bitvec import Expression
@@ -26,6 +28,45 @@ from mythril_tpu.smt.solver.frontend import (
 )
 from mythril_tpu.support.args import args
 from mythril_tpu.support.time_handler import time_handler
+
+# UNSAT verdicts on the DETECTION path ("no vulnerability here") get a
+# second opinion by default: the homegrown CDCL is the sole UNSAT authority
+# in this z3-free environment, so detection-critical UNSATs are re-solved
+# on a permuted instance (solver/sat_backend._crosscheck_unsat).
+# MYTHRIL_TPU_UNSAT_CROSSCHECK=0 force-disables; =1 force-enables even on
+# the engine path (the CI sweep). Engine-internal pruning UNSATs stay
+# single-opinion by default — wrongly pruning a state costs coverage, not
+# a false "safe" verdict on a module predicate, and crosschecking them
+# would double the corpus wall.
+_in_detection_context = False
+
+
+@contextmanager
+def detection_context():
+    """Marks module predicate evaluation / issue confirmation; get_model
+    requests the UNSAT crosscheck inside it."""
+    global _in_detection_context
+    previous = _in_detection_context
+    _in_detection_context = True
+    try:
+        yield
+    finally:
+        _in_detection_context = previous
+
+
+def _crosscheck_wanted() -> bool:
+    env = os.environ.get("MYTHRIL_TPU_UNSAT_CROSSCHECK")
+    if env == "0":
+        return False
+    if env not in (None, ""):
+        return True
+    return _in_detection_context
+
+# When set to a list, every blasted query that reaches a real solve is
+# recorded as (prep, status) — the multichip dryrun uses this to harvest
+# production analyze-derived circuits and re-solve them on the device mesh
+# (__graft_entry__.dryrun_multichip). Never set during normal runs.
+capture_sink: Optional[List] = None
 
 
 class ModelCache:
@@ -84,6 +125,7 @@ def get_model(
     if enforce_execution_time:
         timeout_s = min(timeout_s, max(time_handler.time_remaining() - 0.5, 0.05))
 
+    crosscheck = _crosscheck_wanted()
     key = None
     if not minimize and not maximize:
         key = _cache_key(raw_constraints)
@@ -91,6 +133,10 @@ def get_model(
             cached = _result_cache[key]
             if isinstance(cached, Model):
                 return cached
+            # cached UNSAT is final even in a detection context: it came
+            # from a completed CDCL solve this process, and re-solving it
+            # (a full-timeout repeat) made wall-clock-sensitive timeouts
+            # flip settled verdicts to UNKNOWN on loaded hosts
             raise UnsatError()
         quick = model_cache.check_quick_sat(raw_constraints)
         if quick is not None:
@@ -104,9 +150,12 @@ def get_model(
             solver.maximize(m.raw if isinstance(m, Expression) else m)
     else:
         solver = Solver(timeout=timeout_s)
+    solver.unsat_crosscheck = crosscheck
     solver.add(raw_constraints)
 
     status = solver.check()
+    if capture_sink is not None and getattr(solver, "last_prep", None):
+        capture_sink.append((solver.last_prep, status))
     if status == SAT:
         model = solver.model()
         if key is not None:
@@ -190,9 +239,12 @@ def get_models_batch(
         ineligible = []
         for entry in pending:
             prep = entry[3]
-            if prep.blaster is not None and not any(
-                len(c) == 0 for c in prep.clauses
-            ):
+            has_empty = (
+                prep.clauses.has_empty
+                if hasattr(prep.clauses, "has_empty")
+                else any(len(c) == 0 for c in prep.clauses)
+            )
+            if prep.blaster is not None and not has_empty:
                 eligible.append(entry)
             else:
                 ineligible.append(entry)
@@ -208,8 +260,19 @@ def get_models_batch(
                 (p.num_vars, p.clauses, p.aig_roots)
                 for _, _, _, p in eligible
             ]
+            # difficulty-aware device budget: the flat min(4.0, t) cap
+            # guaranteed the device could never win exactly the heavy
+            # cones the 20x target lives on (round-4 verdict weak #4).
+            # Scale with the batch's blasted size — but never past 60% of
+            # the shared per-query timeout: the CDCL settling pass below
+            # shares the same budget and alone proves UNSAT, so a device
+            # whiff must leave it a real window, not 50 ms
+            total_clauses = sum(len(p.clauses) for _, _, _, p in eligible)
+            device_budget = min(
+                0.6 * timeout_s,
+                max(4.0, 2.0 + total_clauses / 100_000.0))
             bits_list = backend.try_solve_batch_circuit(
-                problems, budget_seconds=min(4.0, timeout_s))
+                problems, budget_seconds=device_budget)
         except Exception as error:
             import logging
 
@@ -238,6 +301,8 @@ def get_models_batch(
         solver.allow_device = False
         solver.timeout = max(0.05, timeout_s - (time.monotonic() - start))
         status = solver._solve_prepared(prep)
+        if capture_sink is not None:
+            capture_sink.append((prep, status))
         if status == SAT:
             model = solver.model()
             results[idx] = ("sat", model)

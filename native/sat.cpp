@@ -12,6 +12,12 @@
 //             assumptions, num_assumptions, timeout_s, conflict_budget,
 //             model_out) -> 10 SAT / 20 UNSAT / 0 UNKNOWN
 // Literals are DIMACS signed ints; model_out[v] in {0,1} for v in 1..num_vars.
+//
+//   aig_cone / aig_emit_cnf: cone extraction + Tseitin export of the shared
+//   AIG (smt/bitblast.py keeps the gate table as flat numpy arrays). These
+//   moved here because the Python export dominated heavy-contract wall time
+//   (ether_send: ~31 s Tseitin + ~37 s ctypes marshalling per round-4 bench
+//   profile vs ~13 s of actual CDCL solving).
 
 #include <algorithm>
 #include <chrono>
@@ -481,5 +487,95 @@ int sat_solve(int num_vars, const int* clause_lits,
       model_out[v + 1] = solver.model_value(v) == kTrue ? 1 : 0;
   }
   return res;
+}
+
+// Mark the cone of `seeds` (AIG literals) in `needed` (size num_vars+1,
+// caller-zeroed or not — it is fully rewritten). gate_lhs/gate_rhs hold the
+// defining gate's input literals per var, -1 for circuit inputs. Gates are
+// created in topological order (children always have smaller var ids), so a
+// single reverse sweep reaches the whole cone. counts_out[0] = cone gate
+// count, counts_out[1] = cone var count.
+void aig_cone(int num_vars, const int* gate_lhs, const int* gate_rhs,
+              const int* seeds, int num_seeds, unsigned char* needed,
+              long long* counts_out) {
+  std::memset(needed, 0, (size_t)num_vars + 1);
+  int high = 0;
+  for (int i = 0; i < num_seeds; ++i) {
+    int var = seeds[i] >> 1;
+    if (var >= 1 && var <= num_vars) {
+      needed[var] = 1;
+      if (var > high) high = var;
+    }
+  }
+  long long gates = 0, vars = 0;
+  for (int var = high; var >= 1; --var) {
+    if (!needed[var]) continue;
+    ++vars;
+    int lhs = gate_lhs[var];
+    if (lhs < 0) continue;  // circuit input
+    ++gates;
+    int rhs = gate_rhs[var];
+    int lv = lhs >> 1, rv = rhs >> 1;
+    if (lv >= 1) needed[lv] = 1;
+    if (rv >= 1) needed[rv] = 1;
+  }
+  counts_out[0] = gates;
+  counts_out[1] = vars;
+}
+
+// Tseitin-export the cone marked in `needed` with variables renumbered into
+// a dense 1..N space in increasing global-var order (matching the Python
+// reference implementation in bitblast.py). Root literals become unit
+// clauses; a FALSE root emits an empty clause and sets meta_out[2].
+// meta_out = {num_dense_vars, num_clauses, has_empty}. Returns lits written.
+// Caller sizes lits_out >= 7*cone_gates + num_roots and
+// offsets_out >= 3*cone_gates + num_roots + 1 (from aig_cone's counts).
+long long aig_emit_cnf(int num_vars, const int* gate_lhs, const int* gate_rhs,
+                       const unsigned char* needed, const int* roots,
+                       int num_roots, int* dense_of_global, int* lits_out,
+                       long long* offsets_out, long long* meta_out) {
+  int dense = 0;
+  for (int var = 1; var <= num_vars; ++var)
+    dense_of_global[var] = needed[var] ? ++dense : 0;
+  dense_of_global[0] = 0;
+  long long n_lits = 0, n_clauses = 0;
+  offsets_out[0] = 0;
+  auto dimacs = [&](int lit) {
+    int d = dense_of_global[lit >> 1];
+    return (lit & 1) ? -d : d;
+  };
+  for (int var = 1; var <= num_vars; ++var) {
+    if (!needed[var]) continue;
+    int lhs = gate_lhs[var];
+    if (lhs < 0) continue;
+    int rhs = gate_rhs[var];
+    int g = dense_of_global[var], a = dimacs(lhs), b = dimacs(rhs);
+    lits_out[n_lits++] = -g;
+    lits_out[n_lits++] = a;
+    offsets_out[++n_clauses] = n_lits;
+    lits_out[n_lits++] = -g;
+    lits_out[n_lits++] = b;
+    offsets_out[++n_clauses] = n_lits;
+    lits_out[n_lits++] = g;
+    lits_out[n_lits++] = -a;
+    lits_out[n_lits++] = -b;
+    offsets_out[++n_clauses] = n_lits;
+  }
+  long long has_empty = 0;
+  for (int i = 0; i < num_roots; ++i) {
+    int root = roots[i];
+    if (root == 1) continue;  // TRUE literal
+    if (root == 0) {          // FALSE literal: trivially unsat
+      offsets_out[++n_clauses] = n_lits;
+      has_empty = 1;
+      continue;
+    }
+    lits_out[n_lits++] = dimacs(root);
+    offsets_out[++n_clauses] = n_lits;
+  }
+  meta_out[0] = dense;
+  meta_out[1] = n_clauses;
+  meta_out[2] = has_empty;
+  return n_lits;
 }
 }

@@ -1,0 +1,220 @@
+"""Positive (firing) tests for the detection modules that were previously
+covered only by "no false positives" sweeps — round-4 verdict item 4: a
+module whose predicate never becomes SAT would pass a negative-only suite
+while silently detecting nothing.
+
+Each test hand-assembles a minimal contract whose ONLY point is to trigger
+one module, runs the module in isolation (whitelist), and asserts the exact
+SWC id fires. Mirrors the reference's per-module pinning in
+/root/reference/tests/integration_tests/analysis_tests.py:9-50.
+
+Together with the positive tests in tests/test_analysis.py (suicide,
+ether_thief, integer, exceptions, origin, predictable_vars,
+arbitrary_write, unchecked_retval), every one of the 17 modules now has at
+least one test proving it can raise its issue.
+"""
+
+from tests.test_analysis import analyze, easm_to_code, wrap_creation
+
+# keccak("AssertionFailed(string)") well-known topic — must match
+# analysis/module/modules/user_assertions.py
+ASSERTION_FAILED_TOPIC = (
+    "0xB42604CB105A16C8F6DB8A41E6B00C0C1B4826465E8BC504B3EB3E88B3E6A4A0"
+)
+
+
+def swc_ids(issues):
+    return {i.swc_id for i in issues}
+
+
+def test_arbitrary_jump_fires():
+    """SWC-127: JUMP straight to an attacker-controlled destination."""
+    runtime = easm_to_code("""
+        PUSH1 0x00
+        CALLDATALOAD
+        JUMP
+    :dest
+        JUMPDEST
+        STOP
+    """)
+    issues = analyze(wrap_creation(runtime), tx_count=1,
+                     modules=["arbitrary_jump"])
+    assert "127" in swc_ids(issues)
+
+
+def test_arbitrary_delegatecall_fires():
+    """SWC-112: DELEGATECALL to a calldata-supplied address."""
+    runtime = easm_to_code("""
+        PUSH1 0x00
+        PUSH1 0x00
+        PUSH1 0x00
+        PUSH1 0x00
+        PUSH1 0x00
+        CALLDATALOAD
+        PUSH2 0xffff
+        DELEGATECALL
+        STOP
+    """)
+    issues = analyze(wrap_creation(runtime), tx_count=1,
+                     modules=["arbitrary_delegatecall"])
+    assert "112" in swc_ids(issues)
+
+
+def test_external_calls_fires():
+    """SWC-107 (external_calls): CALL to a user-supplied address with more
+    than the 2300-gas stipend forwarded."""
+    runtime = easm_to_code("""
+        PUSH1 0x00
+        PUSH1 0x00
+        PUSH1 0x00
+        PUSH1 0x00
+        PUSH1 0x00
+        PUSH1 0x00
+        CALLDATALOAD
+        PUSH2 0xffff
+        CALL
+        STOP
+    """)
+    issues = analyze(wrap_creation(runtime), tx_count=1,
+                     modules=["external_calls"])
+    assert "107" in swc_ids(issues)
+
+
+def test_multiple_sends_fires():
+    """SWC-113: two external calls on one path, then STOP."""
+    call = """
+        PUSH1 0x00
+        PUSH1 0x00
+        PUSH1 0x00
+        PUSH1 0x00
+        PUSH1 0x00
+        PUSH1 0x01
+        PUSH2 0xffff
+        CALL
+        POP
+    """
+    runtime = easm_to_code(call + call + "\nSTOP")
+    issues = analyze(wrap_creation(runtime), tx_count=1,
+                     modules=["multiple_sends"])
+    assert "113" in swc_ids(issues)
+
+
+def test_requirements_violation_fires():
+    """SWC-123: the contract calls itself with empty calldata; the inner
+    frame's guard (calldataload(0) != 0) fails and REVERTs — a
+    callee-reachable requirement violation."""
+    runtime = easm_to_code("""
+        PUSH1 0x00
+        CALLDATALOAD
+        PUSH1 @docall
+        JUMPI
+        PUSH1 0x00
+        PUSH1 0x00
+        REVERT
+    :docall
+        JUMPDEST
+        PUSH1 0x00
+        PUSH1 0x00
+        PUSH1 0x00
+        PUSH1 0x00
+        PUSH1 0x00
+        ADDRESS
+        PUSH2 0xffff
+        CALL
+        STOP
+    """)
+    issues = analyze(wrap_creation(runtime), tx_count=1,
+                     modules=["requirements_violation"])
+    assert "123" in swc_ids(issues)
+
+
+def test_state_change_after_external_call_fires():
+    """SWC-107 (state_change_external_calls): SSTORE after a CALL to a
+    user-supplied address."""
+    runtime = easm_to_code("""
+        PUSH1 0x00
+        PUSH1 0x00
+        PUSH1 0x00
+        PUSH1 0x00
+        PUSH1 0x00
+        PUSH1 0x00
+        CALLDATALOAD
+        PUSH2 0xffff
+        CALL
+        POP
+        PUSH1 0x01
+        PUSH1 0x01
+        SSTORE
+        STOP
+    """)
+    issues = analyze(wrap_creation(runtime), tx_count=1,
+                     modules=["state_change_external_calls"])
+    assert "107" in swc_ids(issues)
+    issue = next(i for i in issues if i.swc_id == "107")
+    assert issue.severity == "Medium"  # user-defined callee address
+
+
+def test_transaction_order_dependence_fires():
+    """SWC-114: one function writes storage[0], another pays out
+    CALL(value=storage[0]) — the payout races the write."""
+    runtime = easm_to_code("""
+        PUSH1 0x00
+        CALLDATALOAD
+        ISZERO
+        PUSH1 @payout
+        JUMPI
+        PUSH1 0x00
+        CALLDATALOAD
+        PUSH1 0x00
+        SSTORE
+        STOP
+    :payout
+        JUMPDEST
+        PUSH1 0x00
+        PUSH1 0x00
+        PUSH1 0x00
+        PUSH1 0x00
+        PUSH1 0x00
+        SLOAD
+        CALLER
+        PUSH2 0xffff
+        CALL
+        STOP
+    """)
+    issues = analyze(wrap_creation(runtime), tx_count=2,
+                     modules=["tx_order_dependence"])
+    assert "114" in swc_ids(issues)
+
+
+def test_unexpected_ether_fires():
+    """SWC-132: a branch depends on a strict balance equality, which forced
+    ether (selfdestruct funding) can always break."""
+    runtime = easm_to_code("""
+        SELFBALANCE
+        PUSH2 0x07d0
+        EQ
+        PUSH1 @eqbranch
+        JUMPI
+        STOP
+    :eqbranch
+        JUMPDEST
+        STOP
+    """)
+    issues = analyze(wrap_creation(runtime), tx_count=1,
+                     modules=["unexpected_ether"])
+    assert "132" in swc_ids(issues)
+
+
+def test_user_assertions_fires():
+    """SWC-110 (user_assertions): LOG1 with the AssertionFailed(string)
+    topic — the MythX/hevm user-assertion signal."""
+    runtime = easm_to_code(f"""
+        PUSH32 {ASSERTION_FAILED_TOPIC}
+        PUSH1 0x00
+        PUSH1 0x00
+        LOG1
+        STOP
+    """)
+    issues = analyze(wrap_creation(runtime), tx_count=1,
+                     modules=["user_assertions"])
+    assert "110" in swc_ids(issues)

@@ -1,0 +1,197 @@
+"""Generate the pinned rubixi-class stress input (bench config-4 proxy).
+
+The reference's BASELINE config 4 names rubixi.sol ("large bytecode, many
+branches"); this environment has no solc, so the branch-explosion regime is
+covered by a synthetic contract assembled with the in-repo EASM assembler:
+
+  * a 33-way function-selector dispatcher (jump-table pattern the
+    disassembler's function discovery recognizes),
+  * per function: a 3-deep chain of data-dependent branches over distinct
+    calldata words (2^3 paths/function before pruning), storage
+    read/modify/write on per-function slots, and 256-bit arithmetic mixing
+    calldata into the stored value,
+  * three planted findings to keep the bench's lost-the-finding guard
+    meaningful: an unguarded SELFDESTRUCT(caller) [SWC-106], an unchecked
+    addition written to storage [SWC-101], and an attacker-directed value
+    transfer [SWC-105 family].
+
+Deterministic: byte-identical output on every run. The pinned copy lives at
+bench_inputs/stress_dispatch.hex; regenerate with
+`python tools/gen_stress_input.py` (prints the hex; `--write` rewrites the
+pinned file).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mythril_tpu.disasm.asm import easm_to_code  # noqa: E402
+
+NUM_PLAIN_FUNCS = 30  # 33 functions total: ~2.4 KiB runtime, >=2x the
+                      # biggest reference corpus row (kinds_of_calls 1.1 KiB)
+PINNED_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "bench_inputs", "stress_dispatch.hex",
+)
+
+
+def selector(i: int) -> int:
+    return (0xA0000000 + i * 0x01010101) & 0xFFFFFFFF
+
+
+def plain_function(i: int) -> str:
+    """3-deep data-dependent branch chain + storage arithmetic."""
+    slot = i + 16
+    return f"""
+:func{i}
+    JUMPDEST
+    PUSH1 0x04
+    CALLDATALOAD
+    PUSH2 0x{0x100 + i:04x}
+    GT
+    PUSH2 @f{i}_a
+    JUMPI
+    PUSH1 0x24
+    CALLDATALOAD
+    PUSH1 0x{slot:02x}
+    SSTORE
+    STOP
+:f{i}_a
+    JUMPDEST
+    PUSH1 0x24
+    CALLDATALOAD
+    PUSH1 0x{i + 1:02x}
+    ADD
+    PUSH2 0x{0x2000 + i:04x}
+    LT
+    PUSH2 @f{i}_b
+    JUMPI
+    PUSH1 0x{slot:02x}
+    SLOAD
+    PUSH1 0x44
+    CALLDATALOAD
+    XOR
+    PUSH1 0x{slot:02x}
+    SSTORE
+    STOP
+:f{i}_b
+    JUMPDEST
+    PUSH1 0x44
+    CALLDATALOAD
+    PUSH1 0x64
+    CALLDATALOAD
+    AND
+    PUSH1 0x{i:02x}
+    EQ
+    PUSH2 @f{i}_c
+    JUMPI
+    STOP
+:f{i}_c
+    JUMPDEST
+    PUSH1 0x{slot:02x}
+    SLOAD
+    PUSH1 0x24
+    CALLDATALOAD
+    MUL
+    PUSH1 0x{slot + 64:02x}
+    SSTORE
+    STOP
+"""
+
+
+def build_runtime() -> bytes:
+    dispatch = """
+    PUSH1 0x00
+    CALLDATALOAD
+    PUSH1 0xe0
+    SHR
+"""
+    for i in range(NUM_PLAIN_FUNCS):
+        dispatch += f"""
+    DUP1
+    PUSH4 0x{selector(i):08x}
+    EQ
+    PUSH2 @func{i}
+    JUMPI
+"""
+    dispatch += f"""
+    DUP1
+    PUSH4 0x{selector(NUM_PLAIN_FUNCS):08x}
+    EQ
+    PUSH2 @kill
+    JUMPI
+    DUP1
+    PUSH4 0x{selector(NUM_PLAIN_FUNCS + 1):08x}
+    EQ
+    PUSH2 @overflow
+    JUMPI
+    DUP1
+    PUSH4 0x{selector(NUM_PLAIN_FUNCS + 2):08x}
+    EQ
+    PUSH2 @payout
+    JUMPI
+    STOP
+"""
+    bodies = "".join(plain_function(i) for i in range(NUM_PLAIN_FUNCS))
+    planted = """
+:kill
+    JUMPDEST
+    CALLER
+    SELFDESTRUCT
+:overflow
+    JUMPDEST
+    PUSH1 0x04
+    CALLDATALOAD
+    PUSH1 0x24
+    CALLDATALOAD
+    ADD
+    PUSH1 0x0f
+    SSTORE
+    STOP
+:payout
+    JUMPDEST
+    PUSH1 0x00
+    PUSH1 0x00
+    PUSH1 0x00
+    PUSH1 0x00
+    PUSH1 0x04
+    CALLDATALOAD
+    PUSH1 0x04
+    CALLDATALOAD
+    PUSH2 0xffff
+    CALL
+    STOP
+"""
+    return easm_to_code(dispatch + bodies + planted)
+
+
+def creation_wrapper(runtime: bytes) -> bytes:
+    init = easm_to_code(f"""
+        PUSH2 0x{len(runtime):04x}
+        PUSH1 0x0f
+        PUSH1 0x00
+        CODECOPY
+        PUSH2 0x{len(runtime):04x}
+        PUSH1 0x00
+        RETURN
+        STOP
+    """)
+    assert len(init) == 15
+    return init + runtime
+
+
+def main():
+    runtime = build_runtime()
+    blob = creation_wrapper(runtime).hex()
+    if "--write" in sys.argv:
+        os.makedirs(os.path.dirname(PINNED_PATH), exist_ok=True)
+        with open(PINNED_PATH, "w") as fd:
+            fd.write(blob + "\n")
+        print(f"wrote {len(runtime)} runtime bytes to {PINNED_PATH}")
+    else:
+        print(blob)
+
+
+if __name__ == "__main__":
+    main()
