@@ -8,6 +8,8 @@ import logging
 from enum import Enum
 from typing import List, Optional, Set, Tuple
 
+from mythril_tpu.support import model as model_mod
+
 log = logging.getLogger(__name__)
 
 
@@ -62,8 +64,6 @@ class DetectionModule:
     def execute(self, target, opcode: Optional[str] = None,
                 prehook: bool = True) -> Optional[List]:
         """target: GlobalState for CALLBACK modules, statespace for POST."""
-        from mythril_tpu.support import model as model_mod
-
         if self.entry_point == EntryPoint.CALLBACK:
             self.current_opcode = opcode
             self.is_prehook = prehook

@@ -260,7 +260,8 @@ class _Prepared:
 
     __slots__ = ("trivial", "original", "lowering", "blaster",
                  "num_vars", "clauses", "objective_bits", "last_bits",
-                 "substitutions", "aig_roots", "symbols", "var_dense")
+                 "substitutions", "aig_roots", "symbols", "var_dense",
+                 "session")
 
     def __init__(self):
         self.trivial: Optional[str] = None
@@ -283,6 +284,9 @@ class _Prepared:
         self.symbols: Optional[set] = None
         # global AIG var -> dense CNF var (the cone's compact numbering)
         self.var_dense: dict = {}
+        # lazily-created per-query native solver session (sat_backend);
+        # holds the loaded instance across assumption probes
+        self.session = None
 
 
 _global_blaster: Optional[Blaster] = None
@@ -418,6 +422,12 @@ class Solver:
     def _solve_prepared(self, prep: "_Prepared",
                         assumptions: List[int] = ()) -> str:
         aig_roots = prep.aig_roots if not assumptions else None
+        # per-query session: the instance loads into a persistent native
+        # solver on first use; every later probe (Optimize bit fixing,
+        # re-solves) reuses it under assumptions with learnt clauses intact
+        if prep.session is None and prep.blaster is not None:
+            prep.session = sat_backend.create_prep_session(
+                prep.num_vars, prep.clauses)
         status, bits = sat_backend.solve_cnf(
             prep.num_vars,
             prep.clauses,
@@ -431,6 +441,7 @@ class Solver:
             # most probes ARE unsat — crosschecking them would multiply
             # minimization cost for no soundness gain
             crosscheck=self.unsat_crosscheck and not assumptions,
+            session_ctx=prep.session,
         )
         if status == SAT:
             prep.last_bits = bits

@@ -80,6 +80,27 @@ def _compile_native() -> Optional[ctypes.CDLL]:
         ctypes.POINTER(ctypes.c_longlong),
         ctypes.POINTER(ctypes.c_longlong),
     ]
+    lib.sat_session_new.restype = ctypes.c_void_p
+    lib.sat_session_new.argtypes = []
+    lib.sat_session_free.restype = None
+    lib.sat_session_free.argtypes = [ctypes.c_void_p]
+    lib.sat_session_add_cnf.restype = None
+    lib.sat_session_add_cnf.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_longlong),
+        ctypes.c_int,
+    ]
+    lib.sat_session_solve.restype = ctypes.c_int
+    lib.sat_session_solve.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_int),
+        ctypes.c_int,
+        ctypes.c_double,
+        ctypes.c_longlong,
+        ctypes.POINTER(ctypes.c_byte),
+    ]
     return lib
 
 
@@ -101,6 +122,81 @@ def get_native_lib():
     return _get_native()
 
 
+# ---------------------------------------------------------------------------
+# Per-query incremental CDCL sessions. A prepared problem's cone instance
+# (up to ~1M clauses on heavy contracts) used to be re-marshalled and
+# re-loaded into a fresh solver for EVERY assumption probe — Optimize's
+# minimization alone fires a dozen probes per exploit. A session loads the
+# instance once; probes solve under assumptions on the persistent solver,
+# reusing its learnt clauses, saved phases, and VSIDS state. (A cross-query
+# global-AIG session was tried first and was 2x SLOWER: every solve must
+# assign and propagate the union of all queries' cones.)
+
+
+class PrepSession:
+    """Owns one native solver pre-loaded with a query's CNF."""
+
+    __slots__ = ("_ptr", "num_vars")
+
+    def __init__(self, ptr, num_vars: int):
+        self._ptr = ptr
+        self.num_vars = num_vars
+
+    def solve(self, assumptions, timeout_seconds: float = 0.0,
+              conflict_budget: int = 0):
+        import numpy as np
+
+        lib = _get_native()
+        assume = np.ascontiguousarray(
+            np.asarray(list(assumptions), dtype=np.int32))
+        model = np.zeros(self.num_vars + 1, dtype=np.int8)
+        i32p = ctypes.POINTER(ctypes.c_int)
+        status = lib.sat_session_solve(
+            self._ptr, assume.ctypes.data_as(i32p), len(assume),
+            float(timeout_seconds), int(conflict_budget),
+            model.ctypes.data_as(ctypes.POINTER(ctypes.c_byte)))
+        if status == 10:
+            return SAT, model.astype(bool)
+        if status == 20:
+            return UNSAT, None
+        return UNKNOWN, None
+
+    def __del__(self):
+        try:
+            lib = _lib  # avoid re-compiling during interpreter shutdown
+            if lib is not None and self._ptr:
+                lib.sat_session_free(self._ptr)
+                self._ptr = None
+        except Exception:
+            pass
+
+
+def create_prep_session(num_vars: int, clauses) -> Optional[PrepSession]:
+    """Load a query's CNF into a fresh persistent solver (None without the
+    native lib). `clauses` may be CNF buffers or a clause list (the latter
+    is normalized through CNF.from_clauses rather than re-flattened here)."""
+    lib = _get_native()
+    if lib is None:
+        return None
+    ptr = lib.sat_session_new()
+    if not ptr:
+        return None
+    import numpy as np
+
+    if not hasattr(clauses, "lits"):
+        from mythril_tpu.smt.bitblast import CNF
+
+        clauses = CNF.from_clauses(clauses)
+    lits_np = np.ascontiguousarray(clauses.lits, dtype=np.int32)
+    offs_np = np.ascontiguousarray(clauses.offsets, dtype=np.int64)
+    lib.sat_session_add_cnf(
+        ptr, num_vars,
+        lits_np.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+        offs_np.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+        len(clauses))
+    return PrepSession(ptr, num_vars)
+
+
 def solve_cnf(
     num_vars: int,
     clauses: Sequence[Tuple[int, ...]],
@@ -110,6 +206,7 @@ def solve_cnf(
     allow_device: bool = True,
     aig_roots=None,
     crosscheck: bool = False,
+    session_ctx: Optional[PrepSession] = None,
 ) -> Tuple[str, Optional[List[bool]]]:
     """Solve CNF with DIMACS-signed literals.
 
@@ -145,6 +242,7 @@ def solve_cnf(
                 timeout_seconds=min(0.5, timeout_seconds or 0.5),
                 conflict_budget=20000,
                 crosscheck=crosscheck,
+                session_ctx=session_ctx,
             )
             if probe_status != UNKNOWN:
                 return probe_status, probe_model
@@ -173,7 +271,14 @@ def solve_cnf(
             timeout_seconds = max(
                 0.05, timeout_seconds - (_time.monotonic() - start))
     lib = _get_native()
-    if lib is not None:
+    if lib is not None and session_ctx is not None:
+        # per-query session: the instance is already loaded; only the
+        # assumptions vary per probe. Models are dense-numbered as usual —
+        # the frontend's independent validation re-checks them against the
+        # ORIGINAL constraints regardless of which path produced them.
+        status, model = session_ctx.solve(
+            assumptions, timeout_seconds, conflict_budget)
+    elif lib is not None:
         status, model = _solve_native(lib, num_vars, clauses, assumptions,
                                       timeout_seconds, conflict_budget)
     else:

@@ -80,6 +80,12 @@ class VarHeap {
     up((int)heap_.size() - 1);
   }
 
+  // incremental sessions: register a variable id past the original range
+  void insert_new(Var v) {
+    if ((int)pos_.size() <= v) pos_.resize(v + 1, -1);
+    insert(v);
+  }
+
   void increased(Var v) {
     if (contains(v)) up(pos_[v]);
   }
@@ -149,6 +155,24 @@ class Solver {
 
   bool ok() const { return ok_; }
 
+  void mark_unsat() { ok_ = false; }
+
+  int num_vars() const { return n_; }
+
+  // incremental sessions: extend the variable space (new AIG gates/inputs)
+  void grow_to(int num_vars) {
+    if (num_vars <= n_) return;
+    assigns_.resize(num_vars, kUndef);
+    phase_.resize(num_vars, kFalse);
+    level_.resize(num_vars, 0);
+    reason_.resize(num_vars, -1);
+    activity_.resize(num_vars, 0.0);
+    seen_.resize(num_vars, 0);
+    watches_.resize(2 * (size_t)num_vars);
+    for (Var v = n_; v < num_vars; ++v) heap_.insert_new(v);
+    n_ = num_vars;
+  }
+
   void add_clause(const Lit* lits, int len) {
     if (!ok_) return;
     std::vector<Lit> c(lits, lits + len);
@@ -170,17 +194,20 @@ class Solver {
     attach(out, false, 0);
   }
 
-  // 10 SAT, 20 UNSAT, 0 unknown
+  // 10 SAT, 20 UNSAT, 0 unknown. Re-entrant for incremental sessions:
+  // level-0 state (DB-implied units, learnt clauses, phases, activity)
+  // persists across calls; everything query-specific is undone here.
   int solve(const std::vector<Lit>& assumptions, double timeout_s,
             int64_t conflict_budget) {
     if (!ok_) return 20;
+    cancel_until(0);
     assumptions_ = assumptions;
     if (timeout_s > 0)
       deadline_ = std::chrono::steady_clock::now() +
                   std::chrono::duration_cast<std::chrono::steady_clock::duration>(
                       std::chrono::duration<double>(timeout_s));
     has_deadline_ = timeout_s > 0;
-    int64_t conflicts_total = 0;
+    int64_t conflicts_total = 0;  // this call only (budget accounting)
     for (int restart = 0;; ++restart) {
       int64_t budget = (int64_t)(100 * luby(2.0, restart));
       int res = search(budget, conflicts_total);
@@ -190,6 +217,16 @@ class Solver {
       if (has_deadline_ && std::chrono::steady_clock::now() > deadline_) return 0;
     }
   }
+
+  // incremental sessions: append one AND-gate's Tseitin triple. `g_var` is
+  // the 1-based external gate var; lhs/rhs are external AIG literals
+  // (2*var+sign; vars 1-based, var 0 = the constant). Solver vars are
+  // external-1. Constant inputs normally fold away in the AIG's smart
+  // constructors; handled anyway for safety.
+  // must run before ingesting clauses between solves: a previous SAT call
+  // leaves decision-level assignments on the trail, and add_clause's
+  // satisfied/falsified-literal simplifications are only sound at level 0
+  void reset_to_root() { cancel_until(0); }
 
   int8_t model_value(Var v) const { return assigns_[v]; }
 
@@ -485,6 +522,60 @@ int sat_solve(int num_vars, const int* clause_lits,
   if (res == 10 && model_out) {
     for (int v = 0; v < num_vars; ++v)
       model_out[v + 1] = solver.model_value(v) == kTrue ? 1 : 0;
+  }
+  return res;
+}
+
+// ---------------------------------------------------------------------------
+// Per-query incremental sessions: one persistent Solver pre-loaded with a
+// query's CNF; assumption probes (Optimize bit fixing, budgeted re-solves)
+// reuse the loaded clause database, learnt clauses, saved phases, and VSIDS
+// state instead of rebuilding the instance per call. Learnt clauses are
+// implied by the clause database alone (assumptions are decisions, never
+// resolution premises), so cross-probe reuse is sound.
+
+void* sat_session_new() { return new Solver(0); }
+
+void sat_session_free(void* session) { delete (Solver*)session; }
+
+// Ingest a flat CNF (DIMACS-signed lits, offsets); the cone instance loads
+// once and every assumption probe reuses it.
+void sat_session_add_cnf(void* session, int num_vars, const int* clause_lits,
+                         const long long* clause_offsets, int num_clauses) {
+  Solver* solver = (Solver*)session;
+  solver->reset_to_root();
+  solver->grow_to(num_vars);
+  std::vector<Lit> buf;
+  for (int c = 0; c < num_clauses; ++c) {
+    long long begin = clause_offsets[c], end = clause_offsets[c + 1];
+    buf.clear();
+    for (long long k = begin; k < end; ++k) {
+      int dim = clause_lits[k];
+      buf.push_back(mk_lit(std::abs(dim) - 1, dim < 0));
+    }
+    if (buf.empty()) { solver->mark_unsat(); return; }
+    solver->add_clause(buf.data(), (int)buf.size());
+    if (!solver->ok()) return;
+  }
+}
+
+// Solve under assumptions (DIMACS-signed EXTERNAL vars, 1-based).
+// model_out[v] for v in 1..num_vars (external numbering); may be null.
+int sat_session_solve(void* session, const int* assumptions,
+                      int num_assumptions, double timeout_s,
+                      long long conflict_budget, signed char* model_out) {
+  Solver* solver = (Solver*)session;
+  std::vector<Lit> assume;
+  assume.reserve(num_assumptions);
+  for (int i = 0; i < num_assumptions; ++i) {
+    int dim = assumptions[i];
+    assume.push_back(mk_lit(std::abs(dim) - 1, dim < 0));
+  }
+  int res = solver->solve(assume, timeout_s, conflict_budget);
+  if (res == 10 && model_out) {
+    int n = solver->num_vars();
+    for (int v = 0; v < n; ++v)
+      model_out[v + 1] = solver->model_value(v) == kTrue ? 1 : 0;
   }
   return res;
 }
