@@ -146,6 +146,66 @@ def propagate_equalities(
     return work, substitutions, False
 
 
+def narrow_bounded_symbols(
+    asserted: List[Term], taken: set
+) -> Tuple[List[Term], List[Tuple[str, Term]]]:
+    """Bounds-driven symbol narrowing (pre-blast word-level rewrite).
+
+    An asserted constant upper bound `x < c` / `x <= c` proves x's high
+    bits are zero; substituting `x := zext(fresh_k)` (k = the bound's bit
+    width) makes those zeros STRUCTURAL, so downstream multiplier partial
+    products, comparison borrow chains, and adder carries over x collapse
+    in the AIG instead of burdening the CDCL. Always sound: the bound
+    constraint itself is kept (it simplifies to true when the bound is an
+    exact power of two), so no models are lost and none are added — any
+    model must satisfy the bound anyway. The substitutions flow through
+    the standard reconstruction machinery (the fresh symbol's "!" prefix
+    keeps it out of visible models). Returns (residual terms, new
+    substitutions); residual None means a constraint folded to false under
+    the restriction — since the restriction loses no models, that proves
+    the original set unsat."""
+    bounds: Dict[str, int] = {}  # name -> tightest narrowed width
+    widths: Dict[str, int] = {}
+    for term in asserted:
+        if term.op not in ("bvult", "bvule"):
+            continue
+        lhs, rhs = term.children
+        if lhs.op != "sym" or not isinstance(lhs.sort, int):
+            continue
+        if not (rhs.is_const and isinstance(rhs.value, int)):
+            continue
+        bound = rhs.value - 1 if term.op == "bvult" else rhs.value
+        if bound < 0:
+            continue  # x < 0: unsat; leave it to the solver
+        narrow = max(1, bound.bit_length())
+        name = lhs.params[0]
+        if name in taken or narrow >= lhs.sort:
+            continue
+        widths[name] = lhs.sort
+        bounds[name] = min(bounds.get(name, narrow), narrow)
+    if not bounds:
+        return asserted, []
+    substitutions: List[Tuple[str, Term]] = []
+    mapping: Dict[str, Term] = {}
+    for name, narrow in bounds.items():
+        width = widths[name]
+        fresh = terms.bv_sym(f"!narrow!{name}", narrow)
+        definition = terms.zext(width - narrow, fresh)
+        mapping[name] = definition
+        substitutions.append((name, definition))
+        taken.add(name)
+    narrowed: List[Term] = []
+    for term in _substitute(asserted, mapping):
+        term = terms.simplify_expr(term)
+        if term.is_const:
+            if term.value is False:
+                # false under the (sound) restriction => unsat overall
+                return None, substitutions
+            continue
+        narrowed.append(term)
+    return narrowed, substitutions
+
+
 class _Lowering:
     """Rewrites a set of bool terms into pure QF_BV + side constraints."""
 
@@ -367,6 +427,16 @@ class Solver:
             asserted
         )
         if unsat:
+            prep.trivial = UNSAT
+            return prep
+        # then narrow constant-bounded symbols so their high bits become
+        # structural zeros (collapses multiplier/comparison cones)
+        taken = {name for name, _ in prep.substitutions}
+        asserted_residual, narrow_subs = narrow_bounded_symbols(
+            asserted_residual, taken
+        )
+        prep.substitutions = prep.substitutions + narrow_subs
+        if asserted_residual is None:
             prep.trivial = UNSAT
             return prep
         # objectives must see the same substitution; iterate because later

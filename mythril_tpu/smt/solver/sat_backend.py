@@ -156,7 +156,10 @@ class PrepSession:
             float(timeout_seconds), int(conflict_budget),
             model.ctypes.data_as(ctypes.POINTER(ctypes.c_byte)))
         if status == 10:
-            return SAT, model.astype(bool)
+            # List[bool], matching _solve_native's contract: np.bool_ would
+            # leak into models and fail the frontend's `is not True`
+            # identity validation on genuinely valid assignments
+            return SAT, model.astype(bool).tolist()
         if status == 20:
             return UNSAT, None
         return UNKNOWN, None

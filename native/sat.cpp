@@ -218,11 +218,8 @@ class Solver {
     }
   }
 
-  // incremental sessions: append one AND-gate's Tseitin triple. `g_var` is
-  // the 1-based external gate var; lhs/rhs are external AIG literals
-  // (2*var+sign; vars 1-based, var 0 = the constant). Solver vars are
-  // external-1. Constant inputs normally fold away in the AIG's smart
-  // constructors; handled anyway for safety.
+
+
   // must run before ingesting clauses between solves: a previous SAT call
   // leaves decision-level assignments on the trail, and add_clause's
   // satisfied/falsified-literal simplifications are only sound at level 0
@@ -246,6 +243,10 @@ class Solver {
   size_t qhead_ = 0;
   double var_inc_ = 1.0, clause_inc_ = 1.0;
   int64_t reduce_next_ = 4000;
+  // lifetime (cross-solve) conflict count: learnt-DB reduction must keep
+  // pace in persistent sessions, where per-call counters restart at 0
+  // every assumption probe and would starve reduce_db() forever
+  int64_t conflicts_lifetime_ = 0;
   bool has_deadline_ = false;
   std::chrono::steady_clock::time_point deadline_;
 
@@ -455,6 +456,7 @@ class Solver {
       if (confl != -1) {
         conflicts++;
         conflicts_total++;
+        conflicts_lifetime_++;
         if (decision_level() == 0) return 20;
         int bt, lbd;
         analyze(confl, learnt, bt, lbd);
@@ -467,7 +469,7 @@ class Solver {
         }
         var_inc_ /= 0.95;
         clause_inc_ /= 0.999;
-        if (conflicts_total >= reduce_next_) {
+        if (conflicts_lifetime_ >= reduce_next_) {
           reduce_db();
           reduce_next_ += 3000;
         }
