@@ -400,7 +400,35 @@ class Blaster:
             return self._eq_bits(self._bv(a), self._bv(b))
         if op in ("bvult", "bvule", "bvslt", "bvsle"):
             return self._compare(op, term.children[0], term.children[1])
+        if op == "umul_novfl":
+            return self._umul_no_ovfl(
+                self._bv(term.children[0]), self._bv(term.children[1])
+            )
         raise NotImplementedError(f"bool lowering: {op}")
+
+    def _umul_no_ovfl(self, xs: List[int], ys: List[int]) -> int:
+        """No-unsigned-mul-overflow at ~half the gates of a double-width
+        multiplier: the product's high half is zero iff no partial product
+        sheds bits past the width (x's top i bits with y_i set) and no
+        accumulation step carries out of the low half. Exact: terms are
+        non-negative, so the running total once >= 2^n stays there."""
+        aig = self.aig
+        size = len(xs)
+        # suffix[j] = OR of xs[j:] (shed-bits detector, shared across steps)
+        suffix = [FALSE_LIT] * (size + 1)
+        for j in range(size - 1, -1, -1):
+            suffix[j] = aig.or_gate(xs[j], suffix[j + 1])
+        acc = [FALSE_LIT] * size
+        overflow = FALSE_LIT
+        for i, y in enumerate(ys):
+            if y == FALSE_LIT:
+                continue
+            if i > 0:
+                overflow = aig.or_gate(overflow, aig.and_gate(y, suffix[size - i]))
+            partial = [FALSE_LIT] * i + [aig.and_gate(x, y) for x in xs[: size - i]]
+            acc, carry = self._add_carry(acc, partial)
+            overflow = aig.or_gate(overflow, carry)
+        return overflow ^ 1
 
     def _eq_bits(self, xs: List[int], ys: List[int]) -> int:
         acc = TRUE_LIT
@@ -508,6 +536,12 @@ class Blaster:
         raise NotImplementedError(f"bv lowering: {op}")
 
     def _add(self, xs: List[int], ys: List[int], carry_in: int = FALSE_LIT) -> List[int]:
+        return self._add_carry(xs, ys, carry_in)[0]
+
+    def _add_carry(
+        self, xs: List[int], ys: List[int], carry_in: int = FALSE_LIT
+    ) -> Tuple[List[int], int]:
+        """Ripple-carry adder returning (sum bits, carry out)."""
         aig = self.aig
         out = []
         carry = carry_in
@@ -515,7 +549,7 @@ class Blaster:
             x_xor_y = aig.xor_gate(x, y)
             out.append(aig.xor_gate(x_xor_y, carry))
             carry = aig.or_gate(aig.and_gate(x, y), aig.and_gate(carry, x_xor_y))
-        return out
+        return out, carry
 
     def _mul(self, xs: List[int], ys: List[int]) -> List[int]:
         """Shift-and-add; constant zero partial products vanish via folding."""

@@ -329,5 +329,11 @@ def BVMulNoOverflow(a: BitVec, b, signed: bool) -> "Bool":
     if signed:
         wide = SignExt(size, a) * SignExt(size, b)
         return SignExt(size, Extract(size - 1, 0, wide)) == wide
-    wide = ZeroExt(size, a) * ZeroExt(size, b)
-    return Extract(2 * size - 1, size, wide) == BitVec.value(0, size)
+    # dedicated no-overflow op: ~half the gates of the double-width
+    # multiplier this used to build (terms.umul_no_ovfl docstring)
+    from mythril_tpu.smt.bool_expr import Bool
+
+    return Bool(
+        terms.umul_no_ovfl(a.raw, b.raw),
+        annotations=a.annotations.union(b.annotations),
+    )

@@ -116,6 +116,8 @@ def _eval_node(node: Term, values: Dict[int, object], assignment: Dict):
         if isinstance(a, ArrayValue) or isinstance(b, ArrayValue):
             raise NotImplementedError("array extensionality not supported")
         return a == b
+    if op == "umul_novfl":
+        return (child[0] * child[1]) >> node.children[0].size == 0
     if op == "bvult":
         return child[0] < child[1]
     if op == "bvule":

@@ -156,9 +156,9 @@ def narrow_bounded_symbols(
     width) makes those zeros STRUCTURAL, so downstream multiplier partial
     products, comparison borrow chains, and adder carries over x collapse
     in the AIG instead of burdening the CDCL. Always sound: the bound
-    constraint itself is kept (it simplifies to true when the bound is an
-    exact power of two), so no models are lost and none are added — any
-    model must satisfy the bound anyway. The substitutions flow through
+    constraint itself is kept (now a cheap comparison over mostly-constant
+    bits), so no models are lost and none are added — any model must
+    satisfy the bound anyway. The substitutions flow through
     the standard reconstruction machinery (the fresh symbol's "!" prefix
     keeps it out of visible models). Returns (residual terms, new
     substitutions); residual None means a constraint folded to false under

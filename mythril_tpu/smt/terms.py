@@ -390,6 +390,28 @@ def bv_cmp(op: str, a: Term, b: Term) -> Term:
     return Term(op, (a, b), (), BOOL)
 
 
+def umul_no_ovfl(a: Term, b: Term) -> Term:
+    """True iff the unsigned product a*b fits in a's width.
+
+    Dedicated op instead of `Extract(2n-1, n, zext*zext) == 0`: the
+    bit-blaster gives it a carry-out-OR network at roughly half the gates
+    of a double-width multiplier (smt/bitblast.py _umul_no_ovfl) — the
+    SWC-101 mul-overflow confirmations are the heaviest query class the
+    engine produces. Constant-by-symbol folds to one comparison:
+    c*b fits iff b <= (2^n - 1) // c."""
+    assert a.sort == b.sort and isinstance(a.sort, int)
+    size = a.size
+    if a.is_const and b.is_const:
+        return bool_val((a.value * b.value) >> size == 0)
+    if a.is_const and not b.is_const:
+        a, b = b, a
+    if b.is_const:
+        if b.value <= 1:
+            return TRUE  # 0 or 1 times anything fits
+        return bv_cmp("bvule", a, bv_val(((1 << size) - 1) // b.value, size))
+    return Term("umul_novfl", (a, b), (), BOOL)
+
+
 def bool_and(parts: Iterable[Term]) -> Term:
     flat = []
     for p in parts:
@@ -625,6 +647,8 @@ def rebuild(term: Term, new_children) -> Term:
         return eq(c[0], c[1])
     if op in ("bvult", "bvule", "bvslt", "bvsle"):
         return bv_cmp(op, c[0], c[1])
+    if op == "umul_novfl":
+        return umul_no_ovfl(c[0], c[1])
     if op == "and":
         return bool_and(c)
     if op == "or":

@@ -218,8 +218,6 @@ class Solver {
     }
   }
 
-
-
   // must run before ingesting clauses between solves: a previous SAT call
   // leaves decision-level assignments on the trail, and add_clause's
   // satisfied/falsified-literal simplifications are only sound at level 0

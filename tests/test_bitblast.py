@@ -117,3 +117,42 @@ def test_division_by_zero_is_evm_zero():
     zero = terms.bv_val(0, W)
     for op in ("bvudiv", "bvurem", "bvsdiv", "bvsrem"):
         check_bv(terms.Term(op, (A, zero), (), W), ["a"], W, rounds=10)
+
+
+def test_umul_no_ovfl_matches_wide_product_encoding():
+    """The dedicated no-overflow circuit (carry-out OR network,
+    bitblast._umul_no_ovfl) must be logically equivalent to the
+    double-width-product encoding it replaced: assert their XOR and prove
+    it UNSAT at small widths, and match the evaluator on random inputs."""
+    import random
+
+    from mythril_tpu.smt import terms
+    from mythril_tpu.smt.eval import evaluate
+    from mythril_tpu.smt.solver import sat_backend
+
+    rng = random.Random(11)
+    for _ in range(100):
+        n = rng.choice([4, 8, 16])
+        a, b = rng.randrange(1 << n), rng.randrange(1 << n)
+        t = terms.umul_no_ovfl(terms.bv_sym("ua", n), terms.bv_sym("ub", n))
+        assert evaluate(t, {"ua": a, "ub": b}) == ((a * b) >> n == 0)
+
+    for n in (4, 6):
+        blaster = Blaster()
+        a_s = terms.bv_sym(f"uva{n}", n)
+        b_s = terms.bv_sym(f"uvb{n}", n)
+        pred = terms.umul_no_ovfl(a_s, b_s)
+        wide = terms.bv_binop(
+            "bvmul", terms.zext(n, a_s), terms.zext(n, b_s))
+        truth = terms.eq(
+            terms.extract(2 * n - 1, n, wide), terms.bv_val(0, n))
+        nvars, cnf, _ = blaster.cnf([terms.bool_xor(pred, truth)])
+        status, _ = sat_backend.solve_cnf(
+            nvars, cnf, timeout_seconds=60, allow_device=False)
+        assert status == "unsat", f"width {n}: encodings disagree"
+
+    # constant-by-symbol folds to a single comparison / trivial truth
+    assert terms.umul_no_ovfl(
+        terms.bv_val(3, 8), terms.bv_sym("uz", 8)).op == "bvule"
+    assert terms.umul_no_ovfl(
+        terms.bv_val(1, 8), terms.bv_sym("uz", 8)) is terms.TRUE

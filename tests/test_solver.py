@@ -353,3 +353,43 @@ def test_grouped_minimize_past_clause_cap():
     # grouped prefix fixing must have driven x down (0 is feasible here);
     # allow a small tail in case the deadline cuts the last few bits
     assert xv < (1 << 16), f"objective not minimized: x={xv:#x}"
+
+
+def test_bounds_narrowing_soundness_and_effect():
+    """narrow_bounded_symbols (frontend): a constant upper bound makes the
+    symbol's high bits structural zeros. Soundness probes: models respect
+    the bound, the boundary value stays reachable, values past the bound
+    stay UNSAT, and the rewrite shrinks a bounded multiplier cone by
+    orders of magnitude."""
+    from mythril_tpu.smt import ULT, symbol_factory
+
+    x = symbol_factory.BitVecSym("nb_x", 256)
+    y = symbol_factory.BitVecSym("nb_y", 256)
+
+    # boundary reachable: x < 0x101 admits exactly x == 0x100 here
+    s = Solver(timeout=30)
+    s.add(ULT(x, symbol_factory.BitVecVal(0x101, 256)))
+    s.add(x > 0xFF)
+    assert s.check() == "sat"
+    assert s.model().eval_int(x) == 0x100
+
+    # past the bound: UNSAT (the kept constraint still bites)
+    s = Solver(timeout=30)
+    s.add(ULT(x, symbol_factory.BitVecVal(0x100, 256)))
+    s.add(x > 0xFF)
+    assert s.check() == "unsat"
+
+    # bounded 256-bit multiplication collapses to a narrow cone and solves
+    s = Solver(timeout=30)
+    s.add(ULT(x, symbol_factory.BitVecVal(1 << 16, 256)))
+    s.add(ULT(y, symbol_factory.BitVecVal(1 << 16, 256)))
+    s.add(x * y == symbol_factory.BitVecVal(391 * 523, 256))
+    s.add(x > 1, y > 1)
+    prep = s._prepare([])
+    assert len(prep.clauses) < 100_000, (
+        "narrowing did not shrink the bounded multiplier cone"
+    )
+    assert s.check() == "sat"
+    model = s.model()
+    xv, yv = model.eval_int(x), model.eval_int(y)
+    assert xv * yv == 391 * 523 and xv < (1 << 16) and yv < (1 << 16)
