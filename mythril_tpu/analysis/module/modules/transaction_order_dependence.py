@@ -60,11 +60,17 @@ class TxOrderDependence(DetectionModule):
             return []
 
         value = state.mstate.stack[-3]
-        callers = [
-            a.caller
-            for annotation_type in (StorageAnnotation, BalanceAnnotation)
-            for a in value.get_annotations(annotation_type)[:1]
-        ]
+        # mirror the reference's gate exactly: a caller is harvested only
+        # when EXACTLY ONE annotation of that type is present (reference
+        # transaction_order_dependence.py appends iff len(annotations) == 1).
+        # A value combining two differently-tainted reads (annotation-set
+        # union through arithmetic) is suppressed — call_constraint stays
+        # False -> UNSAT -> no report, matching the reference's findings.
+        callers = []
+        for annotation_type in (StorageAnnotation, BalanceAnnotation):
+            annotations = value.get_annotations(annotation_type)
+            if len(annotations) == 1:
+                callers.append(annotations[0].caller)
         if not callers:
             return []
         call_constraint = symbol_factory.Bool(False)

@@ -69,24 +69,78 @@ def get_potential_issues_annotation(global_state) -> PotentialIssuesAnnotation:
     return annotation
 
 
+def _detector_cache_key(potential_issue):
+    """(address, bytecode hash) — the detector's per-issue dedup key."""
+    try:
+        from mythril_tpu.utils.keccak import keccak256
+
+        raw = potential_issue.bytecode or b""
+        if isinstance(raw, str):
+            raw = bytes.fromhex(raw.removeprefix("0x"))
+        bytecode_hash = "0x" + keccak256(raw).hex()
+    except ValueError:
+        bytecode_hash = ""
+    return potential_issue.address, bytecode_hash
+
+
 def check_potential_issues(global_state) -> None:
-    """Called at transaction end (engine svm._end_transaction)."""
+    """Called at transaction end (engine svm._end_transaction).
+
+    Confirmation is two-stage: all candidate issues' feasibility checks
+    (world constraints + issue predicate, a detection-critical verdict) go
+    through ONE get_models_batch call first — the batched device fan-out the
+    router size-buckets — and only the satisfiable survivors pay the full
+    exploit concretization with lexicographic minimization. UNSAT/UNKNOWN
+    candidates stay recorded: constraints may become satisfiable after a
+    later transaction mutates state (reference potential_issues.py:97-99)."""
     annotation = get_potential_issues_annotation(global_state)
     unsatisfied = []
+    candidates = []
     for potential_issue in annotation.potential_issues:
         # per-path annotation copies mean sibling end states each carry the
         # same recorded issue; once one path confirmed it (detector cache
         # hit, keyed like Issue.bytecode_hash), skip re-confirming the rest
-        try:
-            from mythril_tpu.utils.keccak import keccak256
+        if _detector_cache_key(potential_issue) in potential_issue.detector.cache:
+            continue
+        candidates.append(potential_issue)
 
-            raw = potential_issue.bytecode or b""
-            if isinstance(raw, str):
-                raw = bytes.fromhex(raw.removeprefix("0x"))
-            bytecode_hash = "0x" + keccak256(raw).hex()
-        except ValueError:
-            bytecode_hash = ""
-        if (potential_issue.address, bytecode_hash) in potential_issue.detector.cache:
+    if len(candidates) > 1:
+        # batched pre-filter: one device-routable fan-out over every
+        # candidate's feasibility cone. The pre-filter solves a SUBSET of
+        # the final constraints (no calldata-size caps yet), so UNSAT here
+        # soundly implies the full confirmation is UNSAT too; SAT survivors
+        # still get the full minimized solve below (and its model now sits
+        # in the model cache).
+        from mythril_tpu.support.model import (
+            detection_context,
+            get_models_batch,
+        )
+
+        try:
+            with detection_context():
+                outcomes = get_models_batch([
+                    (global_state.world_state.constraints
+                     + candidate.constraints).get_all_constraints()
+                    for candidate in candidates
+                ])
+        except Exception:
+            log.exception("batched issue pre-filter failed; confirming "
+                          "candidates one by one")
+            outcomes = [("unknown", None)] * len(candidates)
+        survivors = []
+        for candidate, (status, _model) in zip(candidates, outcomes):
+            if status == "unsat":
+                unsatisfied.append(candidate)
+            else:
+                survivors.append(candidate)
+        candidates = survivors
+
+    for potential_issue in candidates:
+        # re-check the detector cache per candidate: an earlier confirm in
+        # THIS loop may have cached the same (address, bytecode) key (two
+        # recordings of one issue along a looping path) — without this the
+        # duplicate would re-confirm and report twice
+        if _detector_cache_key(potential_issue) in potential_issue.detector.cache:
             continue
         try:
             from mythril_tpu.analysis.solver import get_transaction_sequence

@@ -249,7 +249,27 @@ class MythrilAnalyzer:
         )
         for issue in all_issues:
             report.append_issue(issue)
+        self._dump_stats_json(stats)
         return report
+
+    @staticmethod
+    def _dump_stats_json(stats) -> None:
+        """MYTHRIL_TPU_STATS_JSON=<path>: write the run's SolverStatistics
+        (routing counters, device hits/cap-rejects, batch occupancy,
+        per-route wall) as one JSON object — bench.py reads this from each
+        analyze subprocess so BENCH_r0N.json can report where queries
+        actually went."""
+        import json
+        import os
+
+        path = os.environ.get("MYTHRIL_TPU_STATS_JSON")
+        if not path:
+            return
+        try:
+            with open(path, "w") as fd:
+                json.dump(stats.as_dict(), fd)
+        except OSError:
+            log.warning("could not write solver stats to %s", path)
 
     def _analyze_one_contract(self, contract, modules, tx_count, stats=None):
         """Symbolic execution + modules for ONE contract (the loop body the
@@ -308,32 +328,62 @@ class MythrilAnalyzer:
         shared blaster/AIG, model caches, keccak manager, module
         singletons) makes in-process threading unsound and would serialize
         on the GIL anyway. Spawn (not fork): the parent may hold a jax
-        runtime whose threads a fork would deadlock."""
+        runtime whose threads a fork would deadlock.
+
+        Results stream back via imap_unordered, so a KeyboardInterrupt or a
+        worker failure keeps every contract already completed (the old
+        pool.map was all-or-nothing: one failure re-ran the WHOLE corpus
+        sequentially, potentially doubling wall). Worker failures fall back
+        to sequential analysis of ONLY the incomplete contracts; per-worker
+        SolverStatistics snapshots are folded into the parent singleton."""
         import multiprocessing as mp
 
         workers = min(args.jobs, len(self.contracts))
         payloads = [
-            (contract, self.address, self.strategy, modules, tx_count,
+            (idx, contract, self.address, self.strategy, modules, tx_count,
              dict(args.__dict__))
-            for contract in self.contracts
+            for idx, contract in enumerate(self.contracts)
         ]
         context = mp.get_context("spawn")
-        all_issues: List[Issue] = []
-        exceptions: List[str] = []
+        stats = SolverStatistics()
+        done = {}  # contract idx -> (issues, exceptions)
+        interrupted = False
         try:
             with context.Pool(processes=workers) as pool:
-                for issues, contract_exceptions in pool.map(
-                    _corpus_worker, payloads
-                ):
-                    all_issues.extend(issues)
-                    exceptions.extend(contract_exceptions)
+                for idx, issues, contract_exceptions, stats_snapshot in \
+                        pool.imap_unordered(_corpus_worker, payloads):
+                    done[idx] = (issues, contract_exceptions)
+                    stats.absorb(stats_snapshot)
+        except KeyboardInterrupt:
+            interrupted = True
+            log.critical(
+                "keyboard interrupt: keeping %d/%d completed contracts",
+                len(done), len(payloads))
         except Exception:
             log.exception(
-                "parallel corpus analysis failed; falling back to sequential")
-            all_issues, exceptions = [], []
-            for contract in self.contracts:
-                issues, contract_exceptions = self._analyze_one_contract(
-                    contract, modules, tx_count)
+                "parallel corpus analysis failed; sequential fallback for "
+                "the %d incomplete contracts", len(payloads) - len(done))
+        if interrupted:
+            # a report missing contracts must never read as "those were
+            # safe": surface each unanalyzed contract as an exception row
+            # (Report renders them), mirroring the per-contract capture of
+            # the sequential path
+            for idx, contract in enumerate(self.contracts):
+                if idx not in done:
+                    done[idx] = ([], [
+                        f"analysis of {contract.name} interrupted before "
+                        f"completion (--jobs run): no findings recorded"
+                    ])
+        else:
+            for idx, contract in enumerate(self.contracts):
+                if idx not in done:
+                    done[idx] = self._analyze_one_contract(
+                        contract, modules, tx_count, stats=stats)
+        all_issues: List[Issue] = []
+        exceptions: List[str] = []
+        for idx in range(len(self.contracts)):
+            if idx in done:
+                issues, contract_exceptions = done[idx]
                 all_issues.extend(issues)
                 exceptions.extend(contract_exceptions)
         return all_issues, exceptions
@@ -405,9 +455,10 @@ def _corpus_worker(payload):
 
     Rebuilds the args singleton from the parent's snapshot (spawn starts
     from a fresh interpreter), resets the per-process module/solver state,
-    and runs the standard single-contract path. Issues are plain data and
-    pickle back to the parent."""
-    contract, address, strategy, modules, tx_count, args_state = payload
+    and runs the standard single-contract path. Returns (idx, issues,
+    exceptions, stats snapshot) — all plain data, pickles back to the
+    parent, which aggregates the solver statistics across workers."""
+    idx, contract, address, strategy, modules, tx_count, args_state = payload
     args.__dict__.update(args_state)
     args.jobs = 1  # workers never re-fan-out
     from mythril_tpu.analysis.module import ModuleLoader
@@ -421,8 +472,9 @@ def _corpus_worker(payload):
     disassembler.contracts.append(contract)
     analyzer = MythrilAnalyzer(disassembler, strategy=strategy,
                                address=address)
-    return analyzer._analyze_one_contract(contract, modules, tx_count,
-                                          stats=stats)
+    issues, exceptions = analyzer._analyze_one_contract(
+        contract, modules, tx_count, stats=stats)
+    return idx, issues, exceptions, stats.as_dict()
 
 
 def _signature_db():

@@ -39,9 +39,13 @@ class DelayConstraintStrategy(BasicSearchStrategy):
             # run_round_batch device call (support/model.get_models_batch)
             batch = self.pending_worklist[:DRAIN_BATCH]
             del self.pending_worklist[:DRAIN_BATCH]
+            # engine-path pruning verdicts: wrongly pruning costs coverage,
+            # not a false "safe" verdict — no UNSAT crosscheck (explicit;
+            # matches get_model's non-detection default)
             outcomes = get_models_batch(
                 [s.world_state.constraints.get_all_constraints()
-                 for s in batch]
+                 for s in batch],
+                crosscheck=False,
             )
             for state, (status, _model) in zip(batch, outcomes):
                 if status == "unsat":

@@ -202,9 +202,12 @@ class LaserEVM:
                 # one batched solve over every open state (quick-sat cache
                 # probes happen inside get_models_batch; eligible leftovers
                 # ride a single device call under --solver-backend=tpu)
+                # engine-path reachability verdicts (no UNSAT crosscheck:
+                # a wrong prune costs coverage, not a false "safe")
                 outcomes = get_models_batch(
                     [ws.constraints.get_all_constraints()
-                     for ws in self.open_states]
+                     for ws in self.open_states],
+                    crosscheck=False,
                 )
                 self.open_states = [
                     ws for ws, (status, _model) in zip(self.open_states, outcomes)
@@ -296,9 +299,11 @@ class LaserEVM:
                     # --solver-backend=tpu) instead of serial is_possible
                     from mythril_tpu.support.model import get_models_batch
 
+                    # engine-path fork pruning: crosscheck off, as above
                     outcomes = get_models_batch(
                         [s.world_state.constraints.get_all_constraints()
-                         for s in new_states]
+                         for s in new_states],
+                        crosscheck=False,
                     )
                     new_states = [
                         s for s, (status, _model) in zip(new_states, outcomes)

@@ -134,13 +134,44 @@ def get_native_lib():
 
 
 class PrepSession:
-    """Owns one native solver pre-loaded with a query's CNF."""
+    """Owns one native solver pre-loaded with a query's CNF.
 
-    __slots__ = ("_ptr", "num_vars")
+    A session is single-instance by contract: reloading a live session
+    that already holds learnt clauses from a previous CNF would be unsound
+    (the learnt clauses were derived from the OLD instance). load_cnf
+    enforces that — it refuses a second load instead of trusting every
+    caller to know the rule (round-5 advisor #3)."""
+
+    __slots__ = ("_ptr", "num_vars", "_loaded")
 
     def __init__(self, ptr, num_vars: int):
         self._ptr = ptr
         self.num_vars = num_vars
+        self._loaded = False
+
+    def load_cnf(self, num_vars: int, clauses) -> None:
+        """Load the instance into the native solver — exactly once."""
+        if self._loaded:
+            raise RuntimeError(
+                "PrepSession already holds a CNF instance; a second load "
+                "would solve under learnt clauses from the previous "
+                "instance (unsound). Create a fresh session instead.")
+        import numpy as np
+
+        lib = _get_native()
+        if not hasattr(clauses, "lits"):
+            from mythril_tpu.smt.bitblast import CNF
+
+            clauses = CNF.from_clauses(clauses)
+        lits_np = np.ascontiguousarray(clauses.lits, dtype=np.int32)
+        offs_np = np.ascontiguousarray(clauses.offsets, dtype=np.int64)
+        lib.sat_session_add_cnf(
+            self._ptr, num_vars,
+            lits_np.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+            offs_np.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+            len(clauses))
+        self.num_vars = num_vars
+        self._loaded = True
 
     def solve(self, assumptions, timeout_seconds: float = 0.0,
               conflict_budget: int = 0):
@@ -184,20 +215,9 @@ def create_prep_session(num_vars: int, clauses) -> Optional[PrepSession]:
     ptr = lib.sat_session_new()
     if not ptr:
         return None
-    import numpy as np
-
-    if not hasattr(clauses, "lits"):
-        from mythril_tpu.smt.bitblast import CNF
-
-        clauses = CNF.from_clauses(clauses)
-    lits_np = np.ascontiguousarray(clauses.lits, dtype=np.int32)
-    offs_np = np.ascontiguousarray(clauses.offsets, dtype=np.int64)
-    lib.sat_session_add_cnf(
-        ptr, num_vars,
-        lits_np.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
-        offs_np.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
-        len(clauses))
-    return PrepSession(ptr, num_vars)
+    session = PrepSession(ptr, num_vars)
+    session.load_cnf(num_vars, clauses)
+    return session
 
 
 def solve_cnf(
@@ -249,27 +269,35 @@ def solve_cnf(
             )
             if probe_status != UNKNOWN:
                 return probe_status, probe_model
-        try:
-            from mythril_tpu.tpu.backend import get_device_backend
+        if aig_roots is not None and not assumptions:
+            try:
+                from mythril_tpu.smt.solver.statistics import (
+                    SolverStatistics,
+                )
+                from mythril_tpu.tpu.router import get_router
 
-            device_budget = min(2.0, timeout_seconds * 0.4) \
-                if timeout_seconds else 2.0
-            bits = get_device_backend().try_solve(
-                num_vars, clauses, assumptions, budget_seconds=device_budget,
-                aig_roots=aig_roots)
-            if bits is not None:
-                return SAT, bits
-        except Exception as error:
-            # jax absent OR broken at runtime (device OOM, compile error,
-            # wedged transport): degrade to CDCL-only, never crash the run
-            global _device_warned
-            if not _device_warned:
-                _device_warned = True
-                import logging
+                # the adaptive router owns the device decision (calibrated
+                # caps, cost model, host-fallback deadline, health
+                # breaker); a lone query is just a batch of one
+                stats = SolverStatistics()
+                bits = get_router().dispatch(
+                    [(num_vars, clauses, aig_roots)],
+                    timeout_seconds, stats)[0]
+                stats.add_device_batch_query(hit=bits is not None)
+                if bits is not None:
+                    return SAT, bits
+            except Exception as error:
+                # jax absent OR broken at runtime (device OOM, compile
+                # error, wedged transport): degrade to CDCL-only, never
+                # crash the run
+                global _device_warned
+                if not _device_warned:
+                    _device_warned = True
+                    import logging
 
-                logging.getLogger(__name__).warning(
-                    "device solver unavailable, falling back to CDCL "
-                    "for the rest of the run: %s", error)
+                    logging.getLogger(__name__).warning(
+                        "device solver unavailable, falling back to CDCL "
+                        "for the rest of the run: %s", error)
         if timeout_seconds:
             timeout_seconds = max(
                 0.05, timeout_seconds - (_time.monotonic() - start))
@@ -279,6 +307,16 @@ def solve_cnf(
         # assumptions vary per probe. Models are dense-numbered as usual —
         # the frontend's independent validation re-checks them against the
         # ORIGINAL constraints regardless of which path produced them.
+        # Cheap invariant: a session solves whatever instance it was loaded
+        # with, so a caller handing it a DIFFERENT problem's (num_vars,
+        # clauses) would silently get the wrong verdict (round-5 advisor
+        # #3). A real raise, not assert: python -O must not compile away a
+        # soundness guard
+        if session_ctx.num_vars != num_vars:
+            raise ValueError(
+                f"session holds a {session_ctx.num_vars}-var instance, "
+                f"caller passed {num_vars} vars — wrong session for this "
+                f"problem")
         status, model = session_ctx.solve(
             assumptions, timeout_seconds, conflict_budget)
     elif lib is not None:
@@ -303,6 +341,7 @@ def _crosscheck_enabled() -> bool:
 
 
 CROSSCHECK_CLAUSE_CAP = 150_000
+_crosscheck_cap_warned = False
 
 
 def _crosscheck_unsat(num_vars, clauses, assumptions, timeout_seconds,
@@ -318,8 +357,28 @@ def _crosscheck_unsat(num_vars, clauses, assumptions, timeout_seconds,
     ways: instances past CROSSCHECK_CLAUSE_CAP are skipped (a permuted
     multiplier cone inside the cap budget is almost always UNKNOWN — pure
     cost, no information) and the re-solve itself is capped at 3 s."""
+    from mythril_tpu.smt.solver.statistics import SolverStatistics
+
     if len(clauses) > CROSSCHECK_CLAUSE_CAP:
+        # the skip is counted (and announced once per process): callers —
+        # and CI — must be able to tell a netted UNSAT verdict from one
+        # that never got its second opinion (round-5 advisor #1: the net
+        # is absent on exactly the heaviest confirmation cones, where a
+        # CDCL bug is most likely to hide)
+        SolverStatistics().add_crosscheck(skipped=True)
+        global _crosscheck_cap_warned
+        if not _crosscheck_cap_warned:
+            _crosscheck_cap_warned = True
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "UNSAT crosscheck skipped: instance has %d clauses "
+                "(cap %d). Detection UNSATs this size keep their verdict "
+                "WITHOUT a permuted-instance second opinion; the "
+                "crosscheck_cap_skips statistic counts every such skip "
+                "this run.", len(clauses), CROSSCHECK_CLAUSE_CAP)
         return UNSAT
+    SolverStatistics().add_crosscheck(skipped=False)
     import random as _random
 
     rng = _random.Random(num_vars * 1_000_003 + len(clauses))

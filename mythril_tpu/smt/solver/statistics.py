@@ -7,16 +7,38 @@ from functools import wraps
 class SolverStatistics:
     _instance = None
 
+    # every counter the singleton tracks; used by reset/as_dict/absorb so a
+    # new counter only has to be added in one place
+    _COUNTERS = (
+        "query_count",
+        "batch_query_count",
+        "device_batch_queries",
+        "device_batch_hits",
+        "device_ineligible",
+        "cap_rejects",
+        "cap_rejects_floor",
+        "router_host_direct",
+        "router_slot_overflow",
+        "device_dispatches",
+        "device_dispatched_queries",
+        "device_slots",
+        "crosscheck_runs",
+        "crosscheck_cap_skips",
+    )
+    _TIMERS = (
+        "solver_time",
+        "route_device_seconds",
+        "route_host_seconds",
+    )
+
     def __new__(cls):
         if cls._instance is None:
             cls._instance = super().__new__(cls)
             cls._instance.enabled = False
-            cls._instance.query_count = 0
-            cls._instance.solver_time = 0.0
-            cls._instance.batch_query_count = 0
-            cls._instance.device_batch_queries = 0
-            cls._instance.device_batch_hits = 0
-            cls._instance.device_ineligible = 0
+            for name in cls._COUNTERS:
+                setattr(cls._instance, name, 0)
+            for name in cls._TIMERS:
+                setattr(cls._instance, name, 0.0)
         return cls._instance
 
     def add_query(self, seconds: float) -> None:
@@ -43,13 +65,93 @@ class SolverStatistics:
         if self.enabled:
             self.device_ineligible += 1
 
+    def add_cap_reject(self, count: int = 1,
+                       under_floor: bool = False) -> None:
+        """A circuit the size caps (or the router cost model) turned away
+        from the device. Counted here (not just on the backend) so the
+        analyze stats line and bench can report silently-dropped device work
+        (round-5 verdict: 100% of eligible analyze cones were cap-rejected
+        with no trace). `under_floor` marks a reject of a cone at or under
+        the router's level floor — the class the routing layer GUARANTEES
+        admission for; `cap_rejects_floor` staying 0 is the regression
+        invariant."""
+        if self.enabled:
+            self.cap_rejects += count
+            if under_floor:
+                self.cap_rejects_floor += count
+
+    def add_host_direct(self, count: int = 1) -> None:
+        """Queries the router's cost model sent straight to the host CDCL
+        (too small to amortize a device dispatch)."""
+        if self.enabled:
+            self.router_host_direct += count
+
+    def add_slot_overflow(self, count: int = 1) -> None:
+        """Device-worthy queries trimmed from a dispatch by the
+        evidence-mode slot cap (a different decision than host_direct:
+        these cones were big enough, the evidence budget was not)."""
+        if self.enabled:
+            self.router_slot_overflow += count
+
+    def add_device_dispatch(self, queries: int, slots: int,
+                            seconds: float) -> None:
+        """One bucketed device fan-out: `queries` live queries padded to
+        `slots` device slots. occupancy = queries/slots aggregated."""
+        if self.enabled:
+            self.device_dispatches += 1
+            self.device_dispatched_queries += queries
+            self.device_slots += slots
+            self.route_device_seconds += seconds
+
+    def add_host_route_seconds(self, seconds: float) -> None:
+        if self.enabled:
+            self.route_host_seconds += seconds
+
+    def add_crosscheck(self, skipped: bool) -> None:
+        """A detection-path UNSAT verdict's second opinion: ran, or was
+        skipped by CROSSCHECK_CLAUSE_CAP. The ratio is the fraction of
+        detection UNSATs that actually got a second opinion."""
+        if self.enabled:
+            if skipped:
+                self.crosscheck_cap_skips += 1
+            else:
+                self.crosscheck_runs += 1
+
+    @property
+    def device_occupancy(self) -> float:
+        """Mean fraction of padded device batch slots holding live queries."""
+        if not self.device_slots:
+            return 0.0
+        return self.device_dispatched_queries / self.device_slots
+
     def reset(self) -> None:
-        self.query_count = 0
-        self.solver_time = 0.0
-        self.batch_query_count = 0
-        self.device_batch_queries = 0
-        self.device_batch_hits = 0
-        self.device_ineligible = 0
+        for name in self._COUNTERS:
+            setattr(self, name, 0)
+        for name in self._TIMERS:
+            setattr(self, name, 0.0)
+
+    def as_dict(self) -> dict:
+        """Plain-data snapshot (pickles across the --jobs worker boundary;
+        serializes to the MYTHRIL_TPU_STATS_JSON bench artifact)."""
+        out = {name: getattr(self, name) for name in self._COUNTERS}
+        out.update(
+            {name: round(getattr(self, name), 4) for name in self._TIMERS})
+        out["device_occupancy"] = round(self.device_occupancy, 4)
+        out["device"] = self.device_stats()
+        return out
+
+    def absorb(self, snapshot: dict) -> None:
+        """Fold a worker process's as_dict() into this (parent) singleton.
+        Device-backend stats stay per-process (the backend object never
+        crosses the spawn boundary) — only the routing counters aggregate."""
+        if not self.enabled or not snapshot:
+            return
+        for name in self._COUNTERS:
+            setattr(self, name, getattr(self, name)
+                    + int(snapshot.get(name, 0)))
+        for name in self._TIMERS:
+            setattr(self, name, getattr(self, name)
+                    + float(snapshot.get(name, 0.0)))
 
     def __repr__(self):
         out = (f"Solver statistics: query count: {self.query_count}, "
@@ -59,6 +161,17 @@ class SolverStatistics:
                     f", device-eligible: {self.device_batch_queries}"
                     f" (hits: {self.device_batch_hits})"
                     f", device-ineligible: {self.device_ineligible}")
+        if self.device_dispatches:
+            out += (f", device dispatches: {self.device_dispatches}"
+                    f" (occupancy {self.device_occupancy:.2f},"
+                    f" {self.route_device_seconds:.2f}s device"
+                    f"/{self.route_host_seconds:.2f}s host settle)")
+        if self.router_host_direct or self.cap_rejects:
+            out += (f", routed host-direct: {self.router_host_direct}"
+                    f", cap-rejects: {self.cap_rejects}")
+        if self.crosscheck_runs or self.crosscheck_cap_skips:
+            out += (f", unsat crosschecks: {self.crosscheck_runs}"
+                    f" (+{self.crosscheck_cap_skips} cap-skipped)")
         device = self.device_stats()
         if device:
             out += (f", device pack/ship/solve: {device['pack_seconds']}"

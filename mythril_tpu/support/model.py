@@ -173,24 +173,33 @@ def get_models_batch(
     constraint_sets,
     enforce_execution_time: bool = True,
     solver_timeout: Optional[int] = None,
+    crosscheck: Optional[bool] = None,
 ) -> List:
     """Batched multi-query solve — THE production device fan-out.
 
     Takes N constraint lists (sibling-path feasibility checks: drained
-    pending states, fork sides of one exec iteration) and returns N entries
-    of ("sat", Model) / ("unsat", None) / ("unknown", None).
+    pending states, fork sides of one exec iteration, detection-module
+    confirmation pre-filters) and returns N entries of ("sat", Model) /
+    ("unsat", None) / ("unknown", None).
 
     Pipeline: result-cache + quick-sat probe per query on host; every
-    remaining eligible query is lowered/blasted and shipped to the device
-    in ONE run_round_batch call (no per-query CDCL pre-probe — the batch
-    IS the device's work); leftovers (device miss or dense-cap overflow)
-    are settled by the CDCL, which alone proves UNSAT.
+    remaining eligible query is lowered/blasted and routed by the adaptive
+    query router (tpu/router.py) — tiny cones host-direct, the rest
+    level-bucketed into padded device dispatches under a host-fallback
+    deadline; leftovers (device miss, cap reject, router deadline) are
+    settled by the CDCL, which alone proves UNSAT.
+
+    `crosscheck` requests the permuted-instance UNSAT second opinion on
+    the CDCL settling pass (None = follow the ambient detection context,
+    same policy as get_model).
     """
     from mythril_tpu.smt.solver.frontend import Solver
     from mythril_tpu.smt.solver.statistics import SolverStatistics
 
     stats = SolverStatistics()
     results: List = [None] * len(constraint_sets)
+    if crosscheck is None:
+        crosscheck = _crosscheck_wanted()
 
     timeout_ms = solver_timeout if solver_timeout is not None else args.solver_timeout
     timeout_s = timeout_ms / 1000.0
@@ -250,29 +259,20 @@ def get_models_batch(
                 ineligible.append(entry)
                 stats.add_device_ineligible()
         try:
-            from mythril_tpu.tpu.backend import get_device_backend
+            from mythril_tpu.tpu.router import get_router
 
-            backend = get_device_backend()
-            # the justification-based circuit kernel is the production
-            # device path: it searches over AIG inputs, so blasted
-            # arithmetic actually solves (tpu/circuit.py)
+            # the adaptive router owns the device decision: calibrated
+            # caps, tiny-cone host shortcut, level-bucketed padded
+            # dispatches, and a host-fallback deadline that always leaves
+            # the CDCL settling pass a real window (tpu/router.py). The
+            # justification-based circuit kernel remains the device path:
+            # it searches over AIG inputs, so blasted arithmetic actually
+            # solves (tpu/circuit.py).
             problems = [
                 (p.num_vars, p.clauses, p.aig_roots)
                 for _, _, _, p in eligible
             ]
-            # difficulty-aware device budget: the flat min(4.0, t) cap
-            # guaranteed the device could never win exactly the heavy
-            # cones the 20x target lives on (round-4 verdict weak #4).
-            # Scale with the batch's blasted size — but never past 60% of
-            # the shared per-query timeout: the CDCL settling pass below
-            # shares the same budget and alone proves UNSAT, so a device
-            # whiff must leave it a real window, not 50 ms
-            total_clauses = sum(len(p.clauses) for _, _, _, p in eligible)
-            device_budget = min(
-                0.6 * timeout_s,
-                max(4.0, 2.0 + total_clauses / 100_000.0))
-            bits_list = backend.try_solve_batch_circuit(
-                problems, budget_seconds=device_budget)
+            bits_list = get_router().dispatch(problems, timeout_s, stats)
         except Exception as error:
             import logging
 
@@ -297,8 +297,10 @@ def get_models_batch(
         pending = still_pending
 
     # CDCL settles the rest (and proves UNSAT); plain path, no device re-entry
+    settle_start = time.monotonic()
     for idx, key, solver, prep in pending:
         solver.allow_device = False
+        solver.unsat_crosscheck = crosscheck
         solver.timeout = max(0.05, timeout_s - (time.monotonic() - start))
         status = solver._solve_prepared(prep)
         if capture_sink is not None:
@@ -315,6 +317,7 @@ def get_models_batch(
                 _store_result(key, UNSAT)
         else:
             results[idx] = ("unknown", None)
+    stats.add_host_route_seconds(time.monotonic() - settle_start)
     stats.add_batch(len(constraint_sets), time.monotonic() - start)
     return results
 

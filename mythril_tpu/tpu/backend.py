@@ -29,6 +29,30 @@ import numpy as np
 log = logging.getLogger(__name__)
 
 
+def shape_bucket(n: int) -> int:
+    """1.5x geometric shape bucket for padded kernel dimensions: 64, 96,
+    128, 192, 256, ... Shape buckets amortize jit compiles; the 1.5x
+    intermediate steps halve the worst-case padding waste — production
+    256-bit cones land at ~538 levels, and a pow2 bucket would pad (and
+    pay for) 1024. Shared by the batch kernel's padding and the router's
+    level-bucket grouping (tpu/router.py) so one bucket group pads to
+    exactly one device shape."""
+    size = 64
+    while size < n:
+        if size + size // 2 >= n:
+            return size + size // 2
+        size *= 2
+    return size
+
+
+def _pow2_slots(dp: int, n: int) -> int:
+    """Query-axis padding: pow2 ramp from the mesh's dp size."""
+    q = max(1, dp)
+    while q < n:
+        q *= 2
+    return q
+
+
 def _circuit_struct_key(aig, roots) -> tuple:
     """(aig identity, roots) — the pack/pad/ship cache key. The AIG is
     append-only with structural hashing (bitblast.py), so a root literal's
@@ -200,12 +224,66 @@ class DeviceSolverBackend:
         costs ~ steps * 2*levels * per-ministep-latency. Circuits past the
         cap would blow the per-call budget (round-3's analyze hang: ~2k-level
         keccak cones padded to MAX_LEVELS ran for hours) — they take the
-        CDCL path instead, which solves corpus queries in milliseconds."""
-        if jax.default_backend() == "cpu":
-            # CPU platform pays full jit cost with none of the device speed
-            return 384, 1 << 16, 1 << 12
-        level_cap = int(os.environ.get("MYTHRIL_TPU_LEVEL_CAP", 512))
-        return level_cap, 1 << 20, 1 << 15
+        CDCL path instead.
+
+        Caps are now CALIBRATED, not hard-coded (tpu/router.py): the old
+        static 384/512 level caps rejected every ~513-540-level production
+        analyze cone, so the device solved nothing in the real product path
+        (round-5 verdict). MYTHRIL_TPU_LEVEL_CAP / _CELL_CAP / _VAR_CAP
+        override on any platform."""
+        from mythril_tpu.tpu.router import get_router
+
+        return get_router().resolve_caps(jax.default_backend())
+
+    def count_cap_reject(self, count: int = 1,
+                         under_floor: bool = False) -> None:
+        """A device-eligible cone the size caps (or the router's deadline
+        cost model) turned away — mirrored into SolverStatistics so the
+        product stats line reports it instead of silently dropping it.
+        `under_floor` flags the reject of a cone the routing layer promises
+        to admit (levels <= the router's floor) — must never happen."""
+        self.cap_rejects += count
+        from mythril_tpu.smt.solver.statistics import SolverStatistics
+
+        SolverStatistics().add_cap_reject(count, under_floor=under_floor)
+
+    def pack_problem(self, problem, v1_cap: int):
+        """Levelize one (num_vars, clauses, aig_roots) query through the
+        pack cache; returns the PackedCircuit or None on a pre-pack var-cap
+        reject. Shared by try_solve_batch_circuit and the router's bucketing
+        pass — one pack, one cache, one cap-counting path."""
+        from mythril_tpu.tpu import circuit
+
+        num_vars, _clauses, aig_roots = problem
+        if num_vars + 1 > v1_cap:
+            # the cone has num_vars+1 circuit variables — past the
+            # platform cap it can never run; rejecting BEFORE the
+            # pure-Python levelization keeps heavy queries (50k-var
+            # multiplier confirms) from paying ~1 s of packing for
+            # nothing on every call
+            self.count_cap_reject()
+            return None
+        aig, roots = aig_roots[0], aig_roots[1]
+        skey = _circuit_struct_key(aig, roots)
+        pc, hit = self._pack_cache.get_or(
+            skey, lambda: circuit.PackedCircuit(aig, roots))
+        if hit:
+            self.pack_hits += 1
+        else:
+            self.pack_misses += 1
+        return pc
+
+    def padded_query_slots(self, n: int, single_device: bool = False) -> int:
+        """Query-axis padding the batch kernel will use for n live queries
+        (pow2 ramp from the mesh's dp size) — occupancy accounting."""
+        dp = 1
+        if not single_device:
+            try:
+                jax, _ = self._modules()
+                dp = self._get_mesh(jax).shape["dp"]
+            except Exception:
+                dp = 1
+        return _pow2_slots(dp, n)
 
     def _get_mesh(self, jax):
         """dp x mp mesh over every visible device (1x1 on a single chip)."""
@@ -232,6 +310,10 @@ class DeviceSolverBackend:
         problems: Sequence[Tuple[int, Sequence, Tuple]],
         budget_seconds: float = 4.0,
         size_caps: Optional[Tuple[int, int, int]] = None,
+        num_restarts: Optional[int] = None,
+        steps: Optional[int] = None,
+        prefer_single_device: bool = False,
+        packed_hint: Optional[Sequence] = None,
     ) -> List[Optional[List[bool]]]:
         """Solve many blasted queries with the circuit-SLS kernel in one
         vmapped fan-out. `problems` entries are (num_vars, clauses,
@@ -245,7 +327,17 @@ class DeviceSolverBackend:
         (same function the driver's dryrun exercises).
 
         `size_caps` overrides the platform (level, cell, var) eligibility
-        caps — tests exercise large circuits on the CPU platform this way."""
+        caps — tests exercise large circuits on the CPU platform this way.
+        `num_restarts`/`steps` override the per-round work (the router's
+        platform profiles shrink both on the virtual-CPU platform, where
+        restart lanes serialize on the host core). `prefer_single_device`
+        skips the dp x mp sharded path and pads the query axis from 1
+        instead of dp — on the virtual-CPU platform the mesh is 8 XLA
+        host "devices" time-slicing one core, so sharding buys nothing
+        and the dp-multiple padding costs real round time. `packed_hint`
+        (aligned with `problems`) supplies PackedCircuits the router
+        already built, so packing — and its cache-hit accounting —
+        happens once per query, not twice."""
         from mythril_tpu.tpu import circuit
 
         results: List[Optional[List[bool]]] = [None] * len(problems)
@@ -265,25 +357,17 @@ class DeviceSolverBackend:
         for qi, (num_vars, clauses, aig_roots) in enumerate(problems):
             if num_vars == 0:
                 continue
-            if num_vars + 1 > v1_cap:
-                # the cone has num_vars+1 circuit variables — past the
-                # platform cap it can never run; rejecting BEFORE the
-                # pure-Python levelization keeps heavy queries (50k-var
-                # multiplier confirms) from paying ~1 s of packing for
-                # nothing on every call
-                self.cap_rejects += 1
-                continue
+            if packed_hint is not None and packed_hint[qi] is not None:
+                pc = packed_hint[qi]
+            else:
+                pc = self.pack_problem(
+                    (num_vars, clauses, aig_roots), v1_cap)
+                if pc is None:
+                    continue
             # (aig, roots) or (aig, roots, dense_of_global) — dense maps the
             # shared AIG's var ids onto the problem's compact CNF numbering
-            aig, roots = aig_roots[0], aig_roots[1]
             dense = aig_roots[2] if len(aig_roots) > 2 else None
-            skey = _circuit_struct_key(aig, roots)
-            pc, hit = self._pack_cache.get_or(
-                skey, lambda: circuit.PackedCircuit(aig, roots))
-            if hit:
-                self.pack_hits += 1
-            else:
-                self.pack_misses += 1
+            skey = _circuit_struct_key(aig_roots[0], aig_roots[1])
             if (
                 pc.ok
                 and pc.num_levels <= level_cap
@@ -292,7 +376,7 @@ class DeviceSolverBackend:
             ):
                 packed.append((qi, num_vars, pc, skey, dense))
             elif pc.ok:
-                self.cap_rejects += 1
+                self.count_cap_reject()
         self.pack_seconds += time.monotonic() - pack_start
         if not packed:
             return results
@@ -302,35 +386,30 @@ class DeviceSolverBackend:
         self.batch_queries += len(packed)
         self._seed += 1
 
-        def _bucket(n):
-            # shape buckets amortize jit compiles; 1.5x intermediate steps
-            # (64, 96, 128, 192, ...) halve the worst-case padding waste —
-            # production 256-bit cones land at ~538 levels, and a pow2
-            # bucket would pad (and pay for) 1024
-            size = 64
-            while size < n:
-                if size + size // 2 >= n:
-                    return size + size // 2
-                size *= 2
-            return size
-
-        n_levels = _bucket(max(p.num_levels for _, _, p, _, _ in packed) or 1)
-        width = _bucket(max(p.max_width for _, _, p, _, _ in packed))
-        v1 = _bucket(max(p.v1 for _, _, p, _, _ in packed))
-        n_roots = _bucket(max(p.num_roots for _, _, p, _, _ in packed))
+        n_levels = shape_bucket(
+            max(p.num_levels for _, _, p, _, _ in packed) or 1)
+        width = shape_bucket(max(p.max_width for _, _, p, _, _ in packed))
+        v1 = shape_bucket(max(p.v1 for _, _, p, _, _ in packed))
+        n_roots = shape_bucket(max(p.num_roots for _, _, p, _, _ in packed))
         walk_depth = min(n_levels + 4, circuit.MAX_LEVELS)
 
-        mesh = self._get_mesh(jax)
-        dp = mesh.shape["dp"]
-        mp = mesh.shape["mp"]
-        multi = dp * mp > 1
-        num_restarts = self.num_restarts
+        if prefer_single_device:
+            mesh = None
+            dp = mp = 1
+            multi = False
+        else:
+            mesh = self._get_mesh(jax)
+            dp = mesh.shape["dp"]
+            mp = mesh.shape["mp"]
+            multi = dp * mp > 1
+        if num_restarts is None:
+            num_restarts = self.num_restarts
+        if steps is None:
+            steps = self.CIRCUIT_STEPS
         if multi and num_restarts % mp:
             num_restarts += mp - num_restarts % mp
 
-        q = max(1, dp)
-        while q < len(packed):
-            q *= 2
+        q = _pow2_slots(dp, len(packed))
 
         ship_start = time.monotonic()
         shape_key = (n_levels, width, v1, n_roots)
@@ -373,7 +452,7 @@ class DeviceSolverBackend:
 
             x = jax.device_put(x, NamedSharding(mesh, P("dp", "mp", None)))
             round_fn = self._get_sharded_round(
-                jax, circuit, self.CIRCUIT_STEPS, walk_depth)
+                jax, circuit, steps, walk_depth)
         else:
             round_fn = None
 
@@ -388,10 +467,10 @@ class DeviceSolverBackend:
                 x, found, _solved_dev = round_fn(tensors, x, keys)
             else:
                 x, found = circuit.run_round_circuit_batch(
-                    tensors, x, keys, steps=self.CIRCUIT_STEPS,
+                    tensors, x, keys, steps=steps,
                     walk_depth=walk_depth)
             rounds += 1
-            self.flips += q * num_restarts * self.CIRCUIT_STEPS
+            self.flips += q * num_restarts * steps
             found_host = np.asarray(found)
             round_solved = found_host.any(axis=1)
             newly = round_solved & ~solved

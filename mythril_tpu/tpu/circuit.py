@@ -323,7 +323,10 @@ def make_sharded_round(mesh, steps: int, walk_depth: int):
     Returns fn(tensors, x, keys) -> (x, found, solved) where tensors have a
     leading query axis divisible by dp, x is [Q, R, V1] with R divisible by
     mp, keys is [Q, 2]."""
-    from jax import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:  # jax<=0.4.x keeps it under experimental
+        from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
     def sharded_round(tensors, x, keys):

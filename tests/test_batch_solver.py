@@ -20,9 +20,15 @@ def val(x):
 
 @pytest.fixture(autouse=True)
 def fresh_caches():
+    from mythril_tpu.tpu import router as router_mod
+
     model_mod.clear_caches()
+    # the process-global router carries breaker + evidence-dispatch-cap
+    # state across tests; each test starts with a fresh routing budget
+    router_mod.reset_router()
     yield
     model_mod.clear_caches()
+    router_mod.reset_router()
     args.solver_backend = "cpu"
 
 
@@ -51,24 +57,32 @@ def test_batch_results_cached():
 
 
 def test_batch_rides_one_device_call(monkeypatch):
-    """N eligible queries -> exactly ONE circuit-batch fan-out."""
+    """N same-shape device-worthy queries -> exactly ONE bucketed
+    circuit-batch fan-out (the router groups them into one padded batch).
+    Pins the competitive (real-accelerator) contract: the CPU platform's
+    evidence mode intentionally trims dispatches instead (test_router.py)."""
     from mythril_tpu.tpu import backend as backend_mod
+    from mythril_tpu.tpu.router import QueryRouter, get_router
 
     args.solver_backend = "tpu"
+    monkeypatch.setattr(QueryRouter, "_evidence_mode", lambda self: False)
+    get_router()  # instantiate under the patched profile
     device = backend_mod.get_device_backend()
     calls = []
     real = device.try_solve_batch_circuit
 
-    def spy(problems, budget_seconds=4.0):
+    def spy(problems, **kwargs):
         calls.append(len(problems))
-        return real(problems, budget_seconds=budget_seconds)
+        return real(problems, **kwargs)
 
     monkeypatch.setattr(device, "try_solve_batch_circuit", spy)
 
     queries = []
     for i in range(6):
-        x = bv(f"bq{i}")
-        queries.append([x > val(i), x < val(i + 50)])
+        # adder cones (~10^2 levels): deep enough that the router's cost
+        # model routes them to the device rather than host-direct
+        a, b = bv(f"bqa{i}"), bv(f"bqb{i}")
+        queries.append([a + b == val(1000 + i), a > val(400), b > val(400)])
     outcomes = get_models_batch(queries)
     assert len(calls) == 1, "all sibling queries must ship in one batch"
     assert calls[0] == 6
@@ -76,6 +90,37 @@ def test_batch_rides_one_device_call(monkeypatch):
     for (status, m), q in zip(outcomes, queries):
         # each model must satisfy its own query (validated word-level)
         assert m is not None
+
+
+def test_tiny_cones_route_host_direct(monkeypatch):
+    """Propagation-trivial cones (couple of comparisons) never pay a device
+    dispatch: the router's cost model sends them straight to the host CDCL
+    and counts the decision."""
+    from mythril_tpu.smt.solver.statistics import SolverStatistics
+    from mythril_tpu.tpu import backend as backend_mod
+
+    args.solver_backend = "tpu"
+    stats = SolverStatistics()
+    stats.reset()
+    stats.enabled = True
+    device = backend_mod.get_device_backend()
+    calls = []
+    real = device.try_solve_batch_circuit
+
+    def spy(problems, **kwargs):
+        calls.append(len(problems))
+        return real(problems, **kwargs)
+
+    monkeypatch.setattr(device, "try_solve_batch_circuit", spy)
+    queries = []
+    for i in range(4):
+        x = bv(f"hd{i}")
+        queries.append([x > val(i), x < val(i + 50)])
+    outcomes = get_models_batch(queries)
+    assert all(status == "sat" for status, _ in outcomes)
+    assert calls == [], "tiny cones must not reach the device"
+    assert stats.router_host_direct == 4
+    stats.reset()
 
 
 def test_batch_device_unsat_falls_to_cdcl(monkeypatch):

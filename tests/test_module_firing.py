@@ -186,6 +186,50 @@ def test_transaction_order_dependence_fires():
     assert "114" in swc_ids(issues)
 
 
+def test_transaction_order_dependence_multi_taint_suppressed():
+    """Reference parity: a payout combining TWO tainted storage reads
+    (annotation-set union through ADD) is NOT reported — the reference only
+    harvests a caller when exactly one annotation of the type is present
+    (len == 1), so call_constraint stays False -> UNSAT. The old [:1]
+    harvest reported this case with only the first caller constrained."""
+    runtime = easm_to_code("""
+        PUSH1 0x00
+        CALLDATALOAD
+        ISZERO
+        PUSH1 @payout
+        JUMPI
+        PUSH1 0x00
+        CALLDATALOAD
+        PUSH1 0x00
+        SSTORE
+        PUSH1 0x04
+        CALLDATALOAD
+        PUSH1 0x01
+        SSTORE
+        STOP
+    :payout
+        JUMPDEST
+        PUSH1 0x00
+        PUSH1 0x00
+        PUSH1 0x00
+        PUSH1 0x00
+        PUSH1 0x00
+        SLOAD
+        PUSH1 0x01
+        SLOAD
+        ADD
+        CALLER
+        PUSH2 0xffff
+        CALL
+        STOP
+    """)
+    issues = analyze(wrap_creation(runtime), tx_count=2,
+                     modules=["tx_order_dependence"])
+    assert "114" not in swc_ids(issues), (
+        "multi-taint payout must be suppressed (reference len==1 gate)"
+    )
+
+
 def test_unexpected_ether_fires():
     """SWC-132: a branch depends on a strict balance equality, which forced
     ether (selfdestruct funding) can always break."""

@@ -1,0 +1,142 @@
+"""Unit tests for --jobs corpus fan-out semantics (core._fire_lasers_parallel)
+against a scripted pool — no spawn processes, no fixtures needed.
+
+Pinned behaviors (round-5 advisor #4): results stream via imap_unordered;
+a mid-run failure keeps every completed contract and re-runs ONLY the
+incomplete ones sequentially; a KeyboardInterrupt keeps completed work and
+stops; per-worker SolverStatistics snapshots aggregate into the parent."""
+
+import multiprocessing
+
+import pytest
+
+from mythril_tpu.core import MythrilAnalyzer, MythrilDisassembler
+from mythril_tpu.smt.solver.statistics import SolverStatistics
+from mythril_tpu.support.args import args
+
+
+class FakeContract:
+    def __init__(self, name):
+        self.name = name
+
+
+class ScriptedPool:
+    """imap_unordered yields scripted results, then raises `error`."""
+
+    def __init__(self, results, error=None):
+        self._results = results
+        self._error = error
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def imap_unordered(self, fn, payloads):
+        for result in self._results:
+            yield result
+        if self._error is not None:
+            raise self._error
+
+
+class ScriptedContext:
+    def __init__(self, pool):
+        self._pool = pool
+
+    def Pool(self, processes):
+        return self._pool
+
+
+def _analyzer(n_contracts):
+    disassembler = MythrilDisassembler()
+    disassembler.contracts = [FakeContract(f"c{i}") for i in range(n_contracts)]
+    analyzer = MythrilAnalyzer(disassembler)
+    return analyzer
+
+
+@pytest.fixture(autouse=True)
+def fresh_stats():
+    stats = SolverStatistics()
+    stats.reset()
+    stats.enabled = True
+    saved_jobs = args.jobs
+    yield
+    stats.reset()
+    args.jobs = saved_jobs
+
+
+def _patch_pool(monkeypatch, pool):
+    monkeypatch.setattr(
+        multiprocessing, "get_context", lambda kind: ScriptedContext(pool))
+
+
+def test_worker_failure_reruns_only_incomplete(monkeypatch):
+    args.jobs = 3
+    analyzer = _analyzer(3)
+    # workers finish contracts 0 and 2 (out of order), then the pool dies
+    pool = ScriptedPool(
+        results=[
+            (2, ["issue-c2"], [], {"query_count": 7}),
+            (0, ["issue-c0"], ["boom-c0"], {"query_count": 5}),
+        ],
+        error=RuntimeError("worker lost"),
+    )
+    _patch_pool(monkeypatch, pool)
+    rerun = []
+
+    def fake_analyze_one(contract, modules, tx_count, stats=None):
+        rerun.append(contract.name)
+        return [f"issue-{contract.name}-seq"], []
+
+    monkeypatch.setattr(analyzer, "_analyze_one_contract", fake_analyze_one)
+    issues, exceptions = analyzer._fire_lasers_parallel(None, 1)
+    assert rerun == ["c1"], "only the incomplete contract re-runs"
+    # results assemble in contract order, completed parallel work kept
+    assert issues == ["issue-c0", "issue-c1-seq", "issue-c2"]
+    assert exceptions == ["boom-c0"]
+    # per-worker statistics aggregated into the parent singleton
+    assert SolverStatistics().query_count == 12
+
+
+def test_keyboard_interrupt_keeps_completed_work(monkeypatch):
+    args.jobs = 2
+    analyzer = _analyzer(3)
+    pool = ScriptedPool(
+        results=[(1, ["issue-c1"], [], {})],
+        error=KeyboardInterrupt(),
+    )
+    _patch_pool(monkeypatch, pool)
+    rerun = []
+    monkeypatch.setattr(
+        analyzer, "_analyze_one_contract",
+        lambda contract, modules, tx_count, stats=None: (
+            rerun.append(contract.name) or ([], [])),
+    )
+    issues, exceptions = analyzer._fire_lasers_parallel(None, 1)
+    assert issues == ["issue-c1"], "completed contract results survive ^C"
+    assert rerun == [], "an interrupt must not trigger sequential re-runs"
+    # the report must SAY which contracts went unanalyzed — a truncated
+    # run must never read as "the rest were safe"
+    assert len(exceptions) == 2
+    assert any("c0" in e for e in exceptions)
+    assert any("c2" in e for e in exceptions)
+
+
+def test_clean_run_keeps_contract_order(monkeypatch):
+    args.jobs = 2
+    analyzer = _analyzer(2)
+    pool = ScriptedPool(
+        results=[
+            (1, ["issue-c1"], [], {}),
+            (0, ["issue-c0"], [], {}),
+        ],
+    )
+    _patch_pool(monkeypatch, pool)
+    monkeypatch.setattr(
+        analyzer, "_analyze_one_contract",
+        lambda *a, **k: pytest.fail("nothing to re-run on a clean pass"),
+    )
+    issues, exceptions = analyzer._fire_lasers_parallel(None, 1)
+    assert issues == ["issue-c0", "issue-c1"]
+    assert exceptions == []

@@ -1,0 +1,269 @@
+"""Unit tests for the adaptive device-solver router (tpu/router.py).
+
+The router is exercised against a scripted fake backend so every decision
+path — device-unavailable, calibrated caps, tiny-cone host shortcut,
+round-budget cost model, deadline fallback, health breaker, evidence-mode
+dispatch cap, level bucketing — is asserted without paying jax compiles.
+The real-backend integration is covered by tests/test_batch_solver.py and
+tests/test_analyze_routing.py."""
+
+import pytest
+
+from mythril_tpu.smt.solver.statistics import SolverStatistics
+from mythril_tpu.tpu import router as router_mod
+from mythril_tpu.tpu.router import LEVEL_CAP_FLOOR, QueryRouter
+
+
+class FakePC:
+    def __init__(self, levels, v1=100, width=4, ok=True):
+        self.num_levels = levels
+        self.v1 = v1
+        self.max_width = width
+        self.ok = ok
+
+
+class FakeJax:
+    def default_backend(self):
+        return "cpu"
+
+
+class FakeBackend:
+    """Scripted DeviceSolverBackend stand-in. `answers` maps problem id ->
+    model bits (or None); aig_roots slot of each problem carries its
+    FakePC."""
+
+    num_restarts = 16
+    CIRCUIT_STEPS = 64
+
+    def __init__(self, available=True, answers=None):
+        self._available = available
+        self.answers = answers or {}
+        self.dispatch_log = []  # (problem ids, budget, kwargs)
+        self.cap_rejects = 0
+
+    def available(self):
+        return self._available
+
+    def _modules(self):
+        if not self._available:
+            raise RuntimeError("no jax")
+        return FakeJax(), None
+
+    def count_cap_reject(self, count=1, under_floor=False):
+        self.cap_rejects += count
+        SolverStatistics().add_cap_reject(count, under_floor=under_floor)
+
+    def pack_problem(self, problem, v1_cap):
+        pc = problem[2]
+        if pc.v1 > v1_cap:
+            self.count_cap_reject()
+            return None
+        return pc
+
+    def padded_query_slots(self, n, single_device=False):
+        q = 1
+        while q < n:
+            q *= 2
+        return q
+
+    def try_solve_batch_circuit(self, problems, budget_seconds=4.0,
+                                size_caps=None, **kwargs):
+        self.dispatch_log.append(
+            ([id(p[2]) for p in problems], budget_seconds, kwargs))
+        return [self.answers.get(id(p[2])) for p in problems]
+
+
+def problem(pc):
+    return (pc.v1 - 1, [], pc)
+
+
+@pytest.fixture(autouse=True)
+def fresh_state(monkeypatch):
+    # calibration must not touch jax in unit tests
+    monkeypatch.setenv("MYTHRIL_TPU_CALIBRATE", "0")
+    stats = SolverStatistics()
+    stats.reset()
+    stats.enabled = True
+    yield
+    stats.reset()
+    router_mod.reset_router()
+
+
+def test_device_unavailable_routes_everything_host():
+    backend = FakeBackend(available=False)
+    router = QueryRouter(backend)
+    pc = FakePC(500)
+    results = router.dispatch([problem(pc)], timeout_s=10.0)
+    assert results == [None]
+    assert router.disabled, "unavailable backend must trip the breaker"
+    assert backend.dispatch_log == []
+    # and it stays off without re-probing a broken backend into a crash
+    assert router.dispatch([problem(pc)], timeout_s=10.0) == [None]
+
+
+def test_caps_admit_analyze_scale_cones_by_default():
+    """The round-5 regression: production analyze cones levelize at
+    ~513-540; the default (uncalibrated) caps MUST admit them."""
+    router = QueryRouter(FakeBackend())
+    level, cell, var = router.resolve_caps("cpu")
+    assert level >= LEVEL_CAP_FLOOR >= 640
+    assert cell >= 540 * 1040  # the measured 513-cone is 529k cells
+    assert var >= 5546  # the measured 538-cone has v1=5545
+
+
+def test_level_cap_env_override(monkeypatch):
+    monkeypatch.setenv("MYTHRIL_TPU_LEVEL_CAP", "123")
+    monkeypatch.setenv("MYTHRIL_TPU_VAR_CAP", "456")
+    router = QueryRouter(FakeBackend())
+    level, _cell, var = router.resolve_caps("cpu")
+    assert (level, var) == (123, 456)
+
+
+def test_oversize_cones_counted_not_silent():
+    stats = SolverStatistics()
+    backend = FakeBackend()
+    router = QueryRouter(backend)
+    deep = FakePC(5000)  # past any level cap
+    wide = FakePC(500, v1=1 << 20)  # past the var cap (pre-pack reject)
+    results = router.dispatch([problem(deep), problem(wide)],
+                              timeout_s=10.0, stats=stats)
+    assert results == [None, None]
+    assert backend.cap_rejects == 2
+    assert stats.cap_rejects == 2
+    # neither reject violates the admission floor: the deep cone is past
+    # the floor, the wide one is a pre-pack var reject (depth unknown)
+    assert stats.cap_rejects_floor == 0
+    assert backend.dispatch_log == []
+
+
+def test_tiny_cones_host_direct():
+    stats = SolverStatistics()
+    backend = FakeBackend()
+    router = QueryRouter(backend)
+    results = router.dispatch([problem(FakePC(8))], timeout_s=10.0,
+                              stats=stats)
+    assert results == [None]
+    assert stats.router_host_direct == 1
+    assert backend.dispatch_log == []
+
+
+def test_cost_model_deadline_fallback():
+    """An above-floor cone whose ESTIMATED round time exceeds the round
+    budget is never shipped — the host takes it (deadline fallback),
+    counted as a cap reject so the drop is visible."""
+    backend = FakeBackend()
+    router = QueryRouter(backend)
+    router._per_cell_s = 1.0  # pathological measured latency: 1 s/level
+    results = router.dispatch([problem(FakePC(700))], timeout_s=10.0)
+    assert results == [None]
+    assert backend.cap_rejects == 1
+    assert backend.dispatch_log == []
+
+
+def test_floor_cones_exempt_from_cost_model():
+    """Cones at or under the level floor are the round-5 guarantee: even a
+    pathological latency measurement must not re-create the old
+    reject-everything behavior for production analyze cones."""
+    backend = FakeBackend(answers={})
+    router = QueryRouter(backend)
+    router._per_cell_s = 1.0
+    router.dispatch([problem(FakePC(540))], timeout_s=10.0)
+    assert backend.cap_rejects == 0
+    assert len(backend.dispatch_log) == 1
+
+
+def test_dispatch_budget_bounded_by_deadline_and_timeout():
+    backend = FakeBackend()
+    router = QueryRouter(backend)
+    pc = FakePC(500)
+    router.dispatch([problem(pc)], timeout_s=1.0)
+    # 0.6 x 1.0 s timeout < the 2.5 s cpu deadline
+    assert backend.dispatch_log[-1][1] <= 0.6 * 1.0 + 1e-6
+    router.dispatch([problem(pc)], timeout_s=100.0)
+    assert backend.dispatch_log[-1][1] <= router.dispatch_deadline() + 1e-6
+
+
+def test_breaker_disables_after_fruitless_wall(monkeypatch):
+    monkeypatch.setenv("MYTHRIL_TPU_DEVICE_MAX_WASTE", "0.5")
+    backend = FakeBackend()  # answers empty: every dispatch misses
+    router = QueryRouter(backend)
+    pc = FakePC(500)
+    router.record_dispatch(hits=0, seconds=0.6)
+    assert router.disabled
+    assert router.dispatch([problem(pc)], timeout_s=10.0) == [None]
+    assert backend.dispatch_log == []
+
+
+def test_hits_reset_the_waste_meter(monkeypatch):
+    monkeypatch.setenv("MYTHRIL_TPU_DEVICE_MAX_WASTE", "1.0")
+    router = QueryRouter(FakeBackend())
+    router.record_dispatch(hits=0, seconds=0.7)
+    router.record_dispatch(hits=2, seconds=0.7)  # a hit forgives
+    router.record_dispatch(hits=0, seconds=0.7)
+    assert not router.disabled
+
+
+def test_evidence_mode_dispatch_cap(monkeypatch):
+    """On the CPU platform the device fires a bounded number of times per
+    process, then the host takes everything — the wall-clock guarantee."""
+    monkeypatch.setenv("MYTHRIL_TPU_CPU_DISPATCH_CAP", "2")
+    pc1, pc2, pc3 = FakePC(500), FakePC(500), FakePC(500)
+    backend = FakeBackend(answers={id(pc1): [True], id(pc2): [True],
+                                   id(pc3): [True]})
+    router = QueryRouter(backend)
+    assert router.dispatch([problem(pc1)], timeout_s=10.0) == [[True]]
+    assert router.dispatch([problem(pc2)], timeout_s=10.0) == [[True]]
+    assert router.dispatch([problem(pc3)], timeout_s=10.0) == [None]
+    assert len(backend.dispatch_log) == 2
+
+
+def test_evidence_mode_trims_dispatch_to_slot_cap(monkeypatch):
+    """On the CPU platform round wall scales with padded q (serialized
+    lanes): a big sibling group is trimmed to the slot cap, the overflow
+    goes to the host — counted, never silent."""
+    monkeypatch.setenv("MYTHRIL_TPU_CPU_BATCH_SLOTS", "2")
+    stats = SolverStatistics()
+    pcs = [FakePC(500) for _ in range(5)]
+    backend = FakeBackend(answers={id(pc): [True] for pc in pcs})
+    router = QueryRouter(backend)
+    results = router.dispatch([problem(pc) for pc in pcs],
+                              timeout_s=10.0, stats=stats)
+    assert len(backend.dispatch_log) == 1
+    assert len(backend.dispatch_log[0][0]) == 2
+    assert sum(1 for r in results if r is not None) == 2
+    assert stats.router_slot_overflow == 3
+    assert stats.router_host_direct == 0
+
+
+def test_evidence_profile_shrinks_device_work():
+    backend = FakeBackend()
+    router = QueryRouter(backend)
+    router.dispatch([problem(FakePC(500))], timeout_s=10.0)
+    _ids, _budget, kwargs = backend.dispatch_log[0]
+    assert kwargs["num_restarts"] <= QueryRouter.CPU_PROFILE_RESTARTS
+    assert kwargs["steps"] == QueryRouter.CPU_PROFILE_STEPS
+    assert kwargs["prefer_single_device"] is True
+
+
+def test_level_bucketed_dispatch_groups(monkeypatch):
+    """Mixed-depth batches split into per-bucket dispatches: one deep cone
+    must not force every sibling to pad to its shape."""
+    monkeypatch.setenv("MYTHRIL_TPU_CPU_DISPATCH_CAP", "10")
+    monkeypatch.setenv("MYTHRIL_TPU_CPU_BATCH_SLOTS", "8")
+    stats = SolverStatistics()
+    shallow = [FakePC(130), FakePC(140), FakePC(135)]
+    deep = [FakePC(540)]
+    answers = {id(pc): [True] for pc in shallow + deep}
+    backend = FakeBackend(answers=answers)
+    router = QueryRouter(backend)
+    problems = [problem(pc) for pc in shallow + deep]
+    results = router.dispatch(problems, timeout_s=10.0, stats=stats)
+    assert results == [[True]] * 4
+    assert len(backend.dispatch_log) == 2, "two level buckets -> two groups"
+    sizes = sorted(len(ids) for ids, _b, _k in backend.dispatch_log)
+    assert sizes == [1, 3]
+    # the fullest bucket dispatches first (most models per second spent)
+    assert len(backend.dispatch_log[0][0]) == 3
+    assert stats.device_dispatches == 2
+    assert stats.device_dispatched_queries == 4
+    assert stats.device_slots == 4 + 1  # pow2 padding: 3->4, 1->1

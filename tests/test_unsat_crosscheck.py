@@ -116,3 +116,53 @@ def test_crosscheck_sweep_preserves_findings():
     output = json.loads(proc.stdout.strip().splitlines()[-1])
     assert output["success"]
     assert sorted(i["swc-id"] for i in output["issues"]) == ["106"]
+
+
+def test_crosscheck_cap_skip_is_counted(monkeypatch):
+    """Round-5 advisor #1: a cap-skipped crosscheck must be visible — the
+    statistic tells CI what fraction of detection UNSATs actually got a
+    second opinion."""
+    from mythril_tpu.smt.solver.statistics import SolverStatistics
+
+    stats = SolverStatistics()
+    stats.reset()
+    stats.enabled = True
+    monkeypatch.setattr(sat_backend, "CROSSCHECK_CLAUSE_CAP", 1)
+    with detection_context():
+        with pytest.raises(UnsatError):
+            get_model(_unsat_constraints("capskip"))
+    assert stats.crosscheck_cap_skips >= 1
+    assert stats.crosscheck_runs == 0
+    stats.reset()
+
+
+def test_crosscheck_run_is_counted():
+    from mythril_tpu.smt.solver.statistics import SolverStatistics
+
+    stats = SolverStatistics()
+    stats.reset()
+    stats.enabled = True
+    with detection_context():
+        with pytest.raises(UnsatError):
+            get_model(_unsat_constraints("capran"))
+    assert stats.crosscheck_runs >= 1
+    assert stats.crosscheck_cap_skips == 0
+    stats.reset()
+
+
+def test_prep_session_rejects_second_cnf_load():
+    """Round-5 advisor #3: reloading a live session would solve under
+    learnt clauses from the previous instance (unsound) — refused."""
+    session = sat_backend.create_prep_session(2, [(1, 2), (-1, 2)])
+    if session is None:
+        pytest.skip("native CDCL unavailable")
+    with pytest.raises(RuntimeError, match="already holds"):
+        session.load_cnf(2, [(1,), (2,)])
+
+
+def test_solve_cnf_rejects_session_problem_mismatch():
+    session = sat_backend.create_prep_session(2, [(1, 2), (-1, 2)])
+    if session is None:
+        pytest.skip("native CDCL unavailable")
+    with pytest.raises(ValueError, match="wrong session"):
+        sat_backend.solve_cnf(5, [(1, 2), (3, 4)], session_ctx=session)
