@@ -111,14 +111,16 @@ def check_potential_issues(global_state) -> None:
         # soundly implies the full confirmation is UNSAT too; SAT survivors
         # still get the full minimized solve below (and its model now sits
         # in the model cache).
-        from mythril_tpu.support.model import (
-            detection_context,
-            get_models_batch,
-        )
+        from mythril_tpu.service.scheduler import get_scheduler
+        from mythril_tpu.support.model import detection_context
 
         try:
             with detection_context():
-                outcomes = get_models_batch([
+                # every candidate's feasibility cone rides the coalescing
+                # scheduler: one window flush, one batched router fan-out
+                # (crosscheck=None: resolved against the ambient detection
+                # context at flush time — inside this `with`)
+                outcomes = get_scheduler().solve_batch([
                     (global_state.world_state.constraints
                      + candidate.constraints).get_all_constraints()
                     for candidate in candidates

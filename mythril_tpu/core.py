@@ -209,7 +209,7 @@ class MythrilAnalyzer:
                 "unconstrained_storage", "parallel_solving", "disable_iprof",
                 "disable_mutation_pruner", "disable_dependency_pruning",
                 "enable_state_merging", "enable_summaries", "solver_backend",
-                "transaction_sequences", "beam_width",
+                "solve_cache", "transaction_sequences", "beam_width",
                 "disable_coverage_strategy", "jobs",
             ):
                 if hasattr(cmd_args, field) and getattr(cmd_args, field) is not None:

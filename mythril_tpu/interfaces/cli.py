@@ -179,6 +179,15 @@ def add_analysis_args(parser) -> None:
     parser.add_argument("--solver-backend", default="cpu",
                         choices=["cpu", "tpu"],
                         help="satisfiability backend (tpu = batched device solver)")
+    parser.add_argument("--solve-cache", dest="solve_cache",
+                        default=os.environ.get("MYTHRIL_TPU_SOLVE_CACHE",
+                                               "memory"),
+                        choices=["off", "memory", "disk"],
+                        help="solve-result cache tiers: memory (default) is "
+                             "the in-process term-keyed tier; disk adds the "
+                             "persistent cross-run store under "
+                             "MYTHRIL_TPU_CACHE_DIR; off disables result "
+                             "caching (env default: MYTHRIL_TPU_SOLVE_CACHE)")
     parser.add_argument("--disable-mutation-pruner", action="store_true")
     parser.add_argument("--disable-coverage-strategy", action="store_true")
     parser.add_argument("--disable-dependency-pruning", action="store_true")

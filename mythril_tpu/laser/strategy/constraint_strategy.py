@@ -13,7 +13,7 @@ solver wants.
 import logging
 
 from mythril_tpu.laser.strategy import BasicSearchStrategy
-from mythril_tpu.support.model import get_models_batch
+from mythril_tpu.service.scheduler import get_scheduler
 
 log = logging.getLogger(__name__)
 
@@ -41,8 +41,10 @@ class DelayConstraintStrategy(BasicSearchStrategy):
             del self.pending_worklist[:DRAIN_BATCH]
             # engine-path pruning verdicts: wrongly pruning costs coverage,
             # not a false "safe" verdict — no UNSAT crosscheck (explicit;
-            # matches get_model's non-detection default)
-            outcomes = get_models_batch(
+            # matches get_model's non-detection default). The drained
+            # bundle rides the coalescing scheduler: one window flush per
+            # drain (service/scheduler.py)
+            outcomes = get_scheduler().solve_batch(
                 [s.world_state.constraints.get_all_constraints()
                  for s in batch],
                 crosscheck=False,

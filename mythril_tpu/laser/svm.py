@@ -196,15 +196,17 @@ class LaserEVM:
             # pending strategy probes the model cache before full solves
             # (reference constraint_strategy.py "delayed solving")
             if self.use_reachability_check and i > 0:
-                from mythril_tpu.support.model import get_models_batch
+                from mythril_tpu.service.scheduler import get_scheduler
 
                 before = len(self.open_states)
-                # one batched solve over every open state (quick-sat cache
-                # probes happen inside get_models_batch; eligible leftovers
-                # ride a single device call under --solver-backend=tpu)
-                # engine-path reachability verdicts (no UNSAT crosscheck:
-                # a wrong prune costs coverage, not a false "safe")
-                outcomes = get_models_batch(
+                # every open state's reachability query rides the
+                # coalescing scheduler: one window flush -> one batched
+                # get_models_batch -> level-bucketed router dispatches
+                # (with MYTHRIL_TPU_COALESCE_MS=0 this degrades to the
+                # direct batched call). Engine-path reachability verdicts
+                # (no UNSAT crosscheck: a wrong prune costs coverage, not
+                # a false "safe")
+                outcomes = get_scheduler().solve_batch(
                     [ws.constraints.get_all_constraints()
                      for ws in self.open_states],
                     crosscheck=False,
@@ -294,13 +296,14 @@ class LaserEVM:
                     and self.strategy.run_check()
                     and random.random() < pruning_factor
                 ):
-                    # ALL fork sides of this exec iteration go through one
-                    # batched solve (one device fan-out under
-                    # --solver-backend=tpu) instead of serial is_possible
-                    from mythril_tpu.support.model import get_models_batch
+                    # ALL fork sides of this exec iteration are submitted
+                    # to the coalescing scheduler and demanded together:
+                    # one window flush, one device fan-out under
+                    # --solver-backend=tpu, instead of serial is_possible
+                    from mythril_tpu.service.scheduler import get_scheduler
 
                     # engine-path fork pruning: crosscheck off, as above
-                    outcomes = get_models_batch(
+                    outcomes = get_scheduler().solve_batch(
                         [s.world_state.constraints.get_all_constraints()
                          for s in new_states],
                         crosscheck=False,

@@ -1,0 +1,63 @@
+"""Tiered solve-result service — durable infrastructure in front of the
+solver seam (support/model.py).
+
+Three cooperating parts (the TVM pattern of reusing tuned results across
+compilations, and SOLAR's premise that measured evidence should persist
+across runs):
+
+  store.py       persistent on-disk result tier keyed by a canonical
+                 content fingerprint of the blasted instance; SAT entries
+                 are replay-verified on every hit, UNSAT entries carry
+                 crosscheck provenance (fingerprint.py builds the key)
+  scheduler.py   coalescing solve scheduler: a submit() -> handle facade
+                 with a bounded window that flushes buffered single-query
+                 traffic as ONE level-bucketed router dispatch
+  calibration.py persistent router micro-calibration cache (per platform +
+                 cell profile), so repeated CLI invocations skip the
+                 startup measurement round
+
+Tier selection rides the --solve-cache CLI flag (support/args.py):
+  off     no result caching at all (debugging)
+  memory  the in-memory term-keyed tier only — the pre-service behavior
+  disk    memory tier + the persistent cross-run store under
+          MYTHRIL_TPU_CACHE_DIR
+"""
+
+import os
+
+from mythril_tpu.support.args import args
+
+_MODES = ("off", "memory", "disk")
+
+
+def solve_cache_mode() -> str:
+    mode = getattr(args, "solve_cache", "memory")
+    return mode if mode in _MODES else "memory"
+
+
+def memory_tier_enabled() -> bool:
+    return solve_cache_mode() != "off"
+
+
+def disk_tier_enabled() -> bool:
+    return solve_cache_mode() == "disk"
+
+
+def cache_dir() -> str:
+    """Root of every persistent service artifact (result store,
+    calibration cache, and — via tpu/backend — the XLA compile cache)."""
+    return os.environ.get("MYTHRIL_TPU_CACHE_DIR") or os.path.join(
+        os.path.expanduser("~"), ".cache", "mythril_tpu")
+
+
+def reset_service_state() -> None:
+    """Drop process-local service handles: buffered scheduler state is
+    discarded (unresolved handles degrade to unknown) and the store handle
+    is released so the next access re-opens from disk. clear_caches() calls
+    this so tests and --jobs workers start clean — a cleared process
+    re-populates from the durable tier, never from stale memory."""
+    from mythril_tpu.service.scheduler import reset_scheduler
+    from mythril_tpu.service.store import reset_result_store
+
+    reset_scheduler()
+    reset_result_store()

@@ -1,0 +1,93 @@
+"""Persistent router micro-calibration cache.
+
+The adaptive router (tpu/router.py) derives its device eligibility caps
+from a one-shot per-process measurement of per-cell ministep latency —
+which previously left every CLI invocation paying the measurement round
+(kernel compile + two timed rounds) before its first device dispatch.
+With the disk tier enabled, the measured latency persists beside the
+result store, keyed by (platform, restart lanes, round steps) — the cell
+profile that determines what the measurement actually timed — so repeated
+invocations skip the round entirely.
+
+Entries carry a schema stamp and a measurement timestamp; a schema bump
+or a malformed file degrades to re-measurement, never to a wrong cap.
+"""
+
+import json
+import logging
+import os
+import time
+from typing import Optional
+
+from mythril_tpu.support.lock import LockFile
+
+log = logging.getLogger(__name__)
+
+CALIBRATION_SCHEMA_VERSION = 1
+_FILENAME = "calibration.json"
+
+
+def _path() -> str:
+    from mythril_tpu.service import cache_dir
+
+    return os.path.join(cache_dir(), _FILENAME)
+
+
+def _key(platform: str, restarts: int, steps: int) -> str:
+    return f"{platform}|r{restarts}|s{steps}"
+
+
+def _enabled() -> bool:
+    from mythril_tpu.service import disk_tier_enabled
+
+    return disk_tier_enabled()
+
+
+def load_per_cell_latency(platform: Optional[str], restarts: int,
+                          steps: int) -> Optional[float]:
+    """Cached seconds per (cell x step) for this platform + cell profile,
+    or None (measure)."""
+    if not platform or not _enabled():
+        return None
+    try:
+        with open(_path()) as fd:
+            payload = json.load(fd)
+    except (OSError, ValueError):
+        return None
+    if payload.get("schema") != CALIBRATION_SCHEMA_VERSION:
+        return None
+    entry = payload.get("entries", {}).get(_key(platform, restarts, steps))
+    if not isinstance(entry, dict):
+        return None
+    value = entry.get("per_cell_s")
+    if not isinstance(value, (int, float)) or value <= 0:
+        return None
+    return float(value)
+
+
+def save_per_cell_latency(platform: Optional[str], restarts: int,
+                          steps: int, per_cell_s: float) -> None:
+    if not platform or not _enabled() or not per_cell_s:
+        return
+    path = _path()
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with LockFile(path + ".lock"):
+            payload = {"schema": CALIBRATION_SCHEMA_VERSION, "entries": {}}
+            try:
+                with open(path) as fd:
+                    existing = json.load(fd)
+                if existing.get("schema") == CALIBRATION_SCHEMA_VERSION:
+                    payload = existing
+                    payload.setdefault("entries", {})
+            except (OSError, ValueError):
+                pass
+            payload["entries"][_key(platform, restarts, steps)] = {
+                "per_cell_s": per_cell_s,
+                "measured_at": int(time.time()),
+            }
+            from mythril_tpu.service.store import atomic_write_json
+
+            atomic_write_json(path, payload)
+    except OSError as error:
+        log.info("could not persist calibration (%s)", error)

@@ -1,0 +1,62 @@
+"""Canonical content fingerprint of a blasted solver instance.
+
+The persistent result tier (store.py) is keyed by the *blasted* form of a
+query — the dense-renumbered CNF plus the AIG root literals mapped into
+the same dense numbering — not by constraint-term identity: term objects
+do not survive a process boundary, while the dense cone is a canonical
+per-problem artifact (the blaster renumbers every problem's cone compactly
+regardless of where it sits in the shared global AIG).
+
+Normalization: literals are sorted within each clause (the Tseitin
+exporters emit deterministic but representation-specific literal orders),
+clause order is kept as emitted (deterministic for a given cone). A
+fingerprint collision can never alias a verdict — SAT entries are
+replay-verified against the ORIGINAL constraints on every hit
+(support/model._probe_persistent) and a failed replay is a safe miss.
+"""
+
+import hashlib
+import struct
+from typing import Optional
+
+# bump on ANY change to the fingerprint recipe or the blasting pipeline's
+# canonical form — stale entries must miss, never alias
+FINGERPRINT_SCHEMA = 1
+
+
+def instance_fingerprint(prep) -> Optional[str]:
+    """sha256 hex digest of `prep`'s blasted instance in canonical form,
+    or None when the instance has no blasted CNF (trivial verdicts)."""
+    clauses = getattr(prep, "clauses", None)
+    if clauses is None or getattr(prep, "blaster", None) is None:
+        return None
+    digest = hashlib.sha256()
+    digest.update(b"mythril-tpu-solve-v%d:" % FINGERPRINT_SCHEMA)
+    digest.update(struct.pack("<q", prep.num_vars))
+    if hasattr(clauses, "lits"):
+        import numpy as np
+
+        lits = np.asarray(clauses.lits, dtype=np.int64)
+        offsets = np.asarray(clauses.offsets, dtype=np.int64)
+        lengths = offsets[1:] - offsets[:-1]
+        clause_ids = np.repeat(
+            np.arange(len(lengths), dtype=np.int64), lengths)
+        # within-clause literal sort, clause order preserved: one lexsort
+        # over (clause id, literal) — no per-clause Python loop
+        order = np.lexsort((lits, clause_ids))
+        digest.update(
+            np.ascontiguousarray(lits[order].astype(np.int32)).tobytes())
+        digest.update(np.ascontiguousarray(offsets).tobytes())
+    else:
+        for clause in clauses:
+            for lit in sorted(clause):
+                digest.update(struct.pack("<i", lit))
+            digest.update(b";")
+    # AIG roots, mapped global var -> dense var (the cone's canonical
+    # numbering); constant/outside-cone roots hash as 0
+    if prep.aig_roots is not None:
+        _aig, roots, dense = prep.aig_roots
+        for lit in roots:
+            dense_var = dense.get(lit >> 1) or 0
+            digest.update(struct.pack("<q", (dense_var << 1) | (lit & 1)))
+    return digest.hexdigest()

@@ -1,0 +1,198 @@
+"""Coalescing solve scheduler.
+
+`submit(constraints) -> SolveHandle` buffers eligible single-query solve
+traffic; a flush hands EVERY buffered query to support/model's
+get_models_batch in one call, which level-buckets the eligible cones into
+padded router dispatches (tpu/router.py) — one multi-query device fan-out
+instead of N solo host solves, raising device occupancy.
+
+Flush triggers (bounded window):
+  demand   the first handle whose result is demanded flushes the whole
+           buffer (single-threaded callers can never deadlock on a
+           buffered handle)
+  count    the buffer reaching MYTHRIL_TPU_COALESCE_MAX (default 16)
+  age      a submit arriving after the oldest buffered entry has waited
+           MYTHRIL_TPU_COALESCE_MS (default 6 ms)
+
+The engine's natural seams (fork feasibility in laser/svm.py, the
+pending-state drain in strategy/constraint_strategy.py, open-state
+reachability, and the potential_issues confirmation pre-filter) route
+their sibling-query bundles through solve_batch(), so every one of those
+erstwhile per-query solves joins a window. Honest scope note: the engine
+is synchronous and demands each bundle before proceeding, so today a
+window holds one seam's bundle plus whatever direct submit() traffic was
+buffered since the last flush — the count/age triggers matter for
+submit()-without-demand callers (async frontends, tests), and the
+facade is the seam future traffic sources plug into.
+MYTHRIL_TPU_COALESCE_MS=0 disables coalescing entirely: solve_batch
+degrades to a direct get_models_batch call and submit() solves
+immediately — bit-identical to the pre-service path.
+
+Every flush is counted in SolverStatistics (window_flushes,
+coalesced_queries; coalesce_occupancy = queries per flush).
+"""
+
+import logging
+import os
+import time
+from typing import List, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+DEFAULT_COALESCE_MS = 6.0
+DEFAULT_COALESCE_MAX = 16
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ[name])
+    except (KeyError, ValueError):
+        return default
+
+
+class SolveHandle:
+    """Future-like result of one submitted query. result() returns the
+    get_models_batch outcome tuple: ("sat", Model) / ("unsat", None) /
+    ("unknown", None)."""
+
+    __slots__ = ("_scheduler", "_outcome", "_done")
+
+    def __init__(self, scheduler: "CoalescingScheduler"):
+        self._scheduler = scheduler
+        self._outcome = None
+        self._done = False
+
+    @property
+    def done(self) -> bool:
+        return self._done
+
+    def result(self) -> Tuple[str, Optional[object]]:
+        if not self._done:
+            self._scheduler.flush()
+        return self._outcome
+
+    def _resolve(self, outcome) -> None:
+        self._outcome = outcome
+        self._done = True
+
+
+class CoalescingScheduler:
+    def __init__(self):
+        self.window_ms = _env_float(
+            "MYTHRIL_TPU_COALESCE_MS", DEFAULT_COALESCE_MS)
+        self.max_batch = max(
+            1, int(_env_float("MYTHRIL_TPU_COALESCE_MAX",
+                              DEFAULT_COALESCE_MAX)))
+        self._buffer: List[tuple] = []  # (handle, constraint list, crosscheck)
+        self._oldest: Optional[float] = None
+
+    @property
+    def enabled(self) -> bool:
+        return self.window_ms > 0
+
+    def pending(self) -> int:
+        return len(self._buffer)
+
+    def submit(self, constraints, crosscheck: Optional[bool] = None
+               ) -> SolveHandle:
+        """Buffer one query; returns a handle. With coalescing disabled the
+        query is solved immediately (pass-through)."""
+        handle = SolveHandle(self)
+        if not self.enabled:
+            from mythril_tpu.support.model import get_models_batch
+
+            handle._resolve(
+                get_models_batch([constraints], crosscheck=crosscheck)[0])
+            return handle
+        self._buffer_one(handle, constraints, crosscheck)
+        if len(self._buffer) >= self.max_batch:
+            self.flush()
+        return handle
+
+    def _buffer_one(self, handle, constraints, crosscheck) -> None:
+        now = time.monotonic()
+        if (self._buffer and self._oldest is not None
+                and (now - self._oldest) * 1000.0 >= self.window_ms):
+            # the window expired while nobody demanded a result: flush the
+            # stale cohort before starting a new one
+            self.flush()
+            now = time.monotonic()
+        if not self._buffer:
+            self._oldest = now
+        self._buffer.append((handle, list(constraints), crosscheck))
+
+    def solve_batch(self, constraint_sets,
+                    crosscheck: Optional[bool] = None) -> List:
+        """Seam entry point: buffer every sibling query, then demand all
+        results — the whole bundle (plus anything already buffered) rides
+        ONE window flush regardless of max_batch (the bundle size is
+        already bounded by the caller; splitting it across dispatches
+        would halve bucket occupancy at exactly the seams routing exists
+        for). Degrades to a direct get_models_batch call when coalescing
+        is disabled (bit-identical to the pre-service path)."""
+        if not self.enabled:
+            from mythril_tpu.support.model import get_models_batch
+
+            return get_models_batch(constraint_sets, crosscheck=crosscheck)
+        handles = []
+        for constraints in constraint_sets:
+            handle = SolveHandle(self)
+            self._buffer_one(handle, constraints, crosscheck)
+            handles.append(handle)
+        return [handle.result() for handle in handles]
+
+    def flush(self) -> None:
+        """Solve everything buffered: one get_models_batch call per
+        distinct crosscheck flag (submission order preserved per group)."""
+        if not self._buffer:
+            return
+        from mythril_tpu.smt.solver.statistics import SolverStatistics
+        from mythril_tpu.support.model import get_models_batch
+
+        buffered, self._buffer = self._buffer, []
+        self._oldest = None
+        SolverStatistics().add_window_flush(len(buffered))
+        groups = {}
+        for entry in buffered:
+            groups.setdefault(entry[2], []).append(entry)
+        for flag, entries in groups.items():
+            try:
+                outcomes = get_models_batch(
+                    [constraints for _h, constraints, _f in entries],
+                    crosscheck=flag,
+                )
+            except Exception:
+                # a handle must never dangle: degrade the cohort to
+                # unknown (callers treat unknown as possibly-feasible)
+                log.exception("coalesced solve flush failed; cohort of %d "
+                              "degraded to unknown", len(entries))
+                outcomes = [("unknown", None)] * len(entries)
+            for (handle, _c, _f), outcome in zip(entries, outcomes):
+                handle._resolve(outcome)
+
+    def clear(self) -> None:
+        """Discard buffered state WITHOUT solving (clear_caches/test
+        isolation); unresolved handles degrade to unknown."""
+        buffered, self._buffer = self._buffer, []
+        self._oldest = None
+        for handle, _c, _f in buffered:
+            handle._resolve(("unknown", None))
+
+
+_scheduler: Optional[CoalescingScheduler] = None
+
+
+def get_scheduler() -> CoalescingScheduler:
+    global _scheduler
+    if _scheduler is None:
+        _scheduler = CoalescingScheduler()
+    return _scheduler
+
+
+def reset_scheduler() -> None:
+    """Drop the singleton (env is re-read on next access); buffered
+    queries degrade to unknown rather than solving during teardown."""
+    global _scheduler
+    if _scheduler is not None:
+        _scheduler.clear()
+    _scheduler = None

@@ -1,0 +1,303 @@
+"""Persistent on-disk solve-result tier.
+
+One JSON file per entry under <MYTHRIL_TPU_CACHE_DIR>/solve-cache, named
+by the instance fingerprint (fingerprint.py). The store is shared across
+--jobs worker processes and repeated CLI invocations:
+
+  writes    temp-file + atomic rename under a file lock (support/lock.py),
+            so concurrent workers never observe a torn entry
+  reads     lock-free (rename is atomic); a hit touches the entry's mtime,
+            which is the LRU recency signal
+  eviction  size-capped by entry count (MYTHRIL_TPU_CACHE_MAX_ENTRIES,
+            default 4096): oldest-mtime entries are unlinked under the
+            lock after every write
+  schema    a VERSION stamp file; a mismatch (new code, old store) wipes
+            every entry instead of trusting stale formats
+
+Entry trust model:
+  SAT    stores the satisfying assignment bits (packed, base64). A hit is
+         NEVER trusted as-is — the caller replays the bits through
+         Solver._reconstruct, which validates the rebuilt model against
+         the ORIGINAL constraints, so a fingerprint collision or a
+         corrupted file degrades to a safe miss, not a wrong verdict.
+  UNSAT  stores crosscheck provenance (did the verdict carry the
+         permuted-instance second opinion?). Detection-path lookups only
+         trust provenance-carrying entries; engine-path lookups (where a
+         wrong prune costs coverage, not a false "safe") trust either.
+"""
+
+import base64
+import json
+import logging
+import os
+import tempfile
+from typing import List, Optional
+
+from mythril_tpu.support.lock import LockFile
+
+log = logging.getLogger(__name__)
+
+STORE_SCHEMA_VERSION = 1
+DEFAULT_MAX_ENTRIES = 4096
+# assignments for cones past this many CNF vars are not worth the disk
+# traffic (125 KB+ per entry); the memory tier still serves them in-process
+STORE_VAR_CAP = 1 << 20
+
+
+def _default_root() -> str:
+    from mythril_tpu.service import cache_dir
+
+    return os.path.join(cache_dir(), "solve-cache")
+
+
+def atomic_write_json(path: str, payload: dict) -> bool:
+    """Temp-file + atomic-rename JSON write in `path`'s directory (the
+    caller holds whatever lock the destination needs). Shared by the
+    result store and the calibration cache."""
+    fd, tmp_path = tempfile.mkstemp(dir=os.path.dirname(path),
+                                    suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w") as handle:
+            json.dump(payload, handle)
+        os.replace(tmp_path, path)
+        return True
+    except OSError:
+        try:
+            os.unlink(tmp_path)
+        except OSError:
+            pass
+        return False
+
+
+class StoreEntry:
+    __slots__ = ("verdict", "bits", "num_vars", "crosschecked")
+
+    def __init__(self, verdict: str, bits=None, num_vars: int = 0,
+                 crosschecked: bool = False):
+        self.verdict = verdict
+        self.bits = bits
+        self.num_vars = num_vars
+        self.crosschecked = crosschecked
+
+
+def _pack_bits(bits: List[bool]) -> str:
+    import numpy as np
+
+    packed = np.packbits(np.asarray(bits, dtype=bool))
+    return base64.b64encode(packed.tobytes()).decode("ascii")
+
+
+def _unpack_bits(blob: str, num_vars: int) -> Optional[List[bool]]:
+    import numpy as np
+
+    try:
+        raw = base64.b64decode(blob.encode("ascii"), validate=True)
+    except (ValueError, AttributeError):
+        return None
+    unpacked = np.unpackbits(np.frombuffer(raw, dtype=np.uint8))
+    if len(unpacked) < num_vars + 1:
+        return None
+    return unpacked[: num_vars + 1].astype(bool).tolist()
+
+
+class PersistentResultStore:
+    """File-per-entry result store; every method is total (I/O failures
+    degrade to miss/no-op — the store must never break a solve)."""
+
+    def __init__(self, root: Optional[str] = None,
+                 max_entries: Optional[int] = None):
+        self.root = root or _default_root()
+        if max_entries is None:
+            try:
+                max_entries = int(
+                    os.environ.get("MYTHRIL_TPU_CACHE_MAX_ENTRIES", ""))
+            except ValueError:
+                max_entries = 0
+        self.max_entries = max_entries if max_entries and max_entries > 0 \
+            else DEFAULT_MAX_ENTRIES
+        # approximate local entry count: full directory scans per write
+        # would serialize --jobs workers behind O(entries) stats under the
+        # store lock; the count is re-synced periodically to bound drift
+        # from sibling workers' writes
+        self._approx_count: Optional[int] = None
+        self._writes_since_sync = 0
+        self._ok = self._bootstrap()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def _lock(self) -> LockFile:
+        return LockFile(os.path.join(self.root, ".lock"))
+
+    def _bootstrap(self) -> bool:
+        try:
+            os.makedirs(self.root, exist_ok=True)
+            stamp = os.path.join(self.root, "VERSION")
+            want = str(STORE_SCHEMA_VERSION)
+            current = None
+            try:
+                with open(stamp) as fd:
+                    current = fd.read().strip()
+            except OSError:
+                pass
+            if current == want:
+                return True
+            with self._lock():
+                # re-read under the lock: a sibling worker may have
+                # restamped while this one waited
+                try:
+                    with open(stamp) as fd:
+                        if fd.read().strip() == want:
+                            return True
+                except OSError:
+                    pass
+                for name in os.listdir(self.root):
+                    if name.endswith(".json"):
+                        try:
+                            os.unlink(os.path.join(self.root, name))
+                        except OSError:
+                            pass
+                with open(stamp, "w") as fd:
+                    fd.write(want)
+            return True
+        except OSError as error:
+            log.warning("persistent solve store unavailable at %s (%s); "
+                        "running memory-only", self.root, error)
+            return False
+
+    @property
+    def available(self) -> bool:
+        return self._ok
+
+    def _path(self, fingerprint: str) -> str:
+        return os.path.join(self.root, fingerprint + ".json")
+
+    # -- reads --------------------------------------------------------------
+
+    def lookup(self, fingerprint: str) -> Optional[StoreEntry]:
+        if not self._ok or not fingerprint:
+            return None
+        path = self._path(fingerprint)
+        try:
+            with open(path) as fd:
+                payload = json.load(fd)
+        except (OSError, ValueError):
+            return None
+        if payload.get("schema") != STORE_SCHEMA_VERSION:
+            return None
+        verdict = payload.get("verdict")
+        if verdict == "sat":
+            num_vars = payload.get("num_vars")
+            blob = payload.get("bits")
+            if not isinstance(num_vars, int) or not isinstance(blob, str):
+                return None
+            bits = _unpack_bits(blob, num_vars)
+            if bits is None:
+                return None
+            entry = StoreEntry("sat", bits=bits, num_vars=num_vars)
+        elif verdict == "unsat":
+            entry = StoreEntry(
+                "unsat", crosschecked=bool(payload.get("crosschecked")))
+        else:
+            return None
+        try:
+            os.utime(path, None)  # LRU recency
+        except OSError:
+            pass
+        return entry
+
+    # -- writes -------------------------------------------------------------
+
+    def store_sat(self, fingerprint: str, num_vars: int,
+                  bits: List[bool]) -> bool:
+        if bits is None or num_vars > STORE_VAR_CAP:
+            return False
+        return self._write(fingerprint, {
+            "schema": STORE_SCHEMA_VERSION,
+            "verdict": "sat",
+            "num_vars": num_vars,
+            "bits": _pack_bits(bits),
+        })
+
+    def store_unsat(self, fingerprint: str, crosschecked: bool) -> bool:
+        return self._write(fingerprint, {
+            "schema": STORE_SCHEMA_VERSION,
+            "verdict": "unsat",
+            "crosschecked": bool(crosschecked),
+        })
+
+    _COUNT_SYNC_INTERVAL = 256
+
+    def _write(self, fingerprint: str, payload: dict) -> bool:
+        if not self._ok or not fingerprint:
+            return False
+        try:
+            with self._lock():
+                if not atomic_write_json(self._path(fingerprint), payload):
+                    return False
+                if self._approx_count is None:
+                    self._approx_count = self.entry_count()
+                else:
+                    self._approx_count += 1
+                self._writes_since_sync += 1
+                if self._writes_since_sync >= self._COUNT_SYNC_INTERVAL:
+                    # re-sync against sibling workers' writes
+                    self._approx_count = self.entry_count()
+                    self._writes_since_sync = 0
+                if self._approx_count > self.max_entries:
+                    self._evict_locked()
+                    self._approx_count = self.entry_count()
+            return True
+        except OSError:
+            return False
+
+    def _evict_locked(self) -> None:
+        """LRU eviction by mtime; caller holds the store lock."""
+        try:
+            entries = [
+                name for name in os.listdir(self.root)
+                if name.endswith(".json")
+            ]
+            overflow = len(entries) - self.max_entries
+            if overflow <= 0:
+                return
+            stamped = []
+            for name in entries:
+                path = os.path.join(self.root, name)
+                try:
+                    stamped.append((os.path.getmtime(path), path))
+                except OSError:
+                    pass
+            stamped.sort()
+            for _mtime, path in stamped[:overflow]:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+        except OSError:
+            pass
+
+    def entry_count(self) -> int:
+        if not self._ok:
+            return 0
+        try:
+            return sum(1 for name in os.listdir(self.root)
+                       if name.endswith(".json"))
+        except OSError:
+            return 0
+
+
+_store: Optional[PersistentResultStore] = None
+
+
+def get_result_store() -> PersistentResultStore:
+    """Process-wide store handle (re-reads MYTHRIL_TPU_CACHE_DIR on first
+    access after reset_result_store)."""
+    global _store
+    if _store is None:
+        _store = PersistentResultStore()
+    return _store
+
+
+def reset_result_store() -> None:
+    global _store
+    _store = None

@@ -302,6 +302,12 @@ def solve_cnf(
             timeout_seconds = max(
                 0.05, timeout_seconds - (_time.monotonic() - start))
     lib = _get_native()
+    # one terminal host-CDCL solve (session/native/python alike): the
+    # number the solve-service cache tiers exist to shrink — crosscheck
+    # re-solves are deliberately excluded (they call _solve_* directly)
+    from mythril_tpu.smt.solver.statistics import SolverStatistics
+
+    SolverStatistics().add_cdcl_settle()
     if lib is not None and session_ctx is not None:
         # per-query session: the instance is already loaded; only the
         # assumptions vary per probe. Models are dense-numbered as usual —
@@ -343,6 +349,17 @@ def _crosscheck_enabled() -> bool:
 CROSSCHECK_CLAUSE_CAP = 150_000
 _crosscheck_cap_warned = False
 
+# outcome of the most recent _crosscheck_unsat in this thread: True only
+# when the permuted re-solve POSITIVELY re-proved UNSAT (cap-skips and
+# inconclusive timeouts are False). The persistent result store reads this
+# right after an UNSAT settle to record provenance-as-confirmed, never
+# provenance-as-requested (support/model._crosscheck_confirmed).
+_last_crosscheck_confirmed = False
+
+
+def last_crosscheck_confirmed() -> bool:
+    return _last_crosscheck_confirmed
+
 
 def _crosscheck_unsat(num_vars, clauses, assumptions, timeout_seconds,
                       conflict_budget=0) -> str:
@@ -359,6 +376,8 @@ def _crosscheck_unsat(num_vars, clauses, assumptions, timeout_seconds,
     cost, no information) and the re-solve itself is capped at 3 s."""
     from mythril_tpu.smt.solver.statistics import SolverStatistics
 
+    global _last_crosscheck_confirmed
+    _last_crosscheck_confirmed = False
     if len(clauses) > CROSSCHECK_CLAUSE_CAP:
         # the skip is counted (and announced once per process): callers —
         # and CI — must be able to tell a netted UNSAT verdict from one
@@ -447,6 +466,9 @@ def _crosscheck_unsat(num_vars, clauses, assumptions, timeout_seconds,
             "(%d vars, %d clauses) — degrading verdict to UNKNOWN",
             num_vars, len(clauses))
         return UNKNOWN
+    # UNSAT = positively re-proved; UNKNOWN (timeout) keeps the verdict
+    # but is NOT a confirmation — persistence must not record it as one
+    _last_crosscheck_confirmed = second == UNSAT
     return UNSAT
 
 

@@ -24,6 +24,20 @@ class SolverStatistics:
         "device_slots",
         "crosscheck_runs",
         "crosscheck_cap_skips",
+        # solve-service tiers (mythril_tpu/service/): where each query's
+        # verdict actually came from
+        "memory_hits",
+        "quick_sat_hits",
+        "persistent_hits",
+        "persistent_misses",
+        "persistent_stores",
+        "persistent_verify_rejects",
+        # coalescing scheduler windows
+        "window_flushes",
+        "coalesced_queries",
+        # real host-CDCL solver invocations (counted at the sat_backend
+        # terminal solve — the number every cache tier exists to shrink)
+        "cdcl_settles",
     )
     _TIMERS = (
         "solver_time",
@@ -117,6 +131,59 @@ class SolverStatistics:
             else:
                 self.crosscheck_runs += 1
 
+    def add_memory_hit(self) -> None:
+        """A query settled by the in-memory term-keyed result tier."""
+        if self.enabled:
+            self.memory_hits += 1
+
+    def add_quick_sat_hit(self) -> None:
+        """A query settled by the recent-model quick-sat probe."""
+        if self.enabled:
+            self.quick_sat_hits += 1
+
+    def add_persistent_lookup(self, hit: bool) -> None:
+        """A disk-tier probe of a blasted instance fingerprint. A
+        verify-rejected or provenance-rejected entry counts as a miss
+        (the caller also records the reject reason)."""
+        if self.enabled:
+            if hit:
+                self.persistent_hits += 1
+            else:
+                self.persistent_misses += 1
+
+    def add_persistent_store(self) -> None:
+        if self.enabled:
+            self.persistent_stores += 1
+
+    def add_persistent_verify_reject(self) -> None:
+        """A disk-tier SAT entry whose replayed assignment failed model
+        validation against the original constraints (fingerprint collision
+        or corrupted file) — degraded to a safe miss, never a verdict."""
+        if self.enabled:
+            self.persistent_verify_rejects += 1
+
+    def add_window_flush(self, queries: int) -> None:
+        """One coalescing-scheduler flush covering `queries` buffered
+        queries (service/scheduler.py)."""
+        if self.enabled:
+            self.window_flushes += 1
+            self.coalesced_queries += queries
+
+    def add_cdcl_settle(self) -> None:
+        """One real host-CDCL solver invocation (sat_backend terminal
+        solve). Every cache tier exists to shrink this number; warm runs
+        must show strictly fewer than cold runs."""
+        if self.enabled:
+            self.cdcl_settles += 1
+
+    @property
+    def coalesce_occupancy(self) -> float:
+        """Mean queries per coalescing-window flush (>1 means single-query
+        traffic actually merged into multi-query dispatches)."""
+        if not self.window_flushes:
+            return 0.0
+        return self.coalesced_queries / self.window_flushes
+
     @property
     def device_occupancy(self) -> float:
         """Mean fraction of padded device batch slots holding live queries."""
@@ -137,6 +204,7 @@ class SolverStatistics:
         out.update(
             {name: round(getattr(self, name), 4) for name in self._TIMERS})
         out["device_occupancy"] = round(self.device_occupancy, 4)
+        out["coalesce_occupancy"] = round(self.coalesce_occupancy, 4)
         out["device"] = self.device_stats()
         return out
 
@@ -169,6 +237,20 @@ class SolverStatistics:
         if self.router_host_direct or self.cap_rejects:
             out += (f", routed host-direct: {self.router_host_direct}"
                     f", cap-rejects: {self.cap_rejects}")
+        if self.memory_hits or self.quick_sat_hits or self.persistent_hits \
+                or self.persistent_misses:
+            out += (f", cache tiers: memory {self.memory_hits}"
+                    f"/quick-sat {self.quick_sat_hits}"
+                    f"/persistent {self.persistent_hits}"
+                    f" (misses {self.persistent_misses},"
+                    f" verify-rejects {self.persistent_verify_rejects},"
+                    f" stores {self.persistent_stores})")
+        if self.window_flushes:
+            out += (f", coalesce windows: {self.window_flushes}"
+                    f" flushes ({self.coalesced_queries} queries,"
+                    f" occupancy {self.coalesce_occupancy:.2f})")
+        if self.cdcl_settles:
+            out += f", cdcl settles: {self.cdcl_settles}"
         if self.crosscheck_runs or self.crosscheck_cap_skips:
             out += (f", unsat crosschecks: {self.crosscheck_runs}"
                     f" (+{self.crosscheck_cap_skips} cap-skipped)")

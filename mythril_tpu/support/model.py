@@ -3,8 +3,11 @@
 get_model(constraints, ...) is the single choke point every reachability
 check and exploit concretization goes through:
 
-  model cache -> quick-sat probe over recent models -> full solve with a
-  deadline capped by the global time budget -> cache the model.
+  memory result tier -> quick-sat probe over recent models -> persistent
+  disk tier (mythril_tpu/service/store.py, keyed by the blasted instance's
+  content fingerprint, replay-verified) -> full solve with a deadline
+  capped by the global time budget -> cache the verdict into every
+  enabled tier.
 
 raises UnsatError on unsat, SolverTimeOutException on unknown.
 This is also the designed backend seam: `args.solver_backend` selects the
@@ -26,6 +29,7 @@ from mythril_tpu.smt.solver.frontend import (
     SolverTimeOutException,
     UnsatError,
 )
+from mythril_tpu.smt.solver.statistics import SolverStatistics
 from mythril_tpu.support.args import args
 from mythril_tpu.support.time_handler import time_handler
 
@@ -95,16 +99,128 @@ _RESULT_CACHE_MAX = 2 ** 12
 
 
 def _cache_key(terms_list) -> Optional[tuple]:
-    """Order-insensitive key: the constraint terms sorted by hash.
+    """Order- and multiplicity-insensitive key: the DEDUPLICATED constraint
+    terms sorted by hash. Constraint-list concatenation routinely repeats
+    terms ([a, a] vs [a] — same conjunction), so duplicates are dropped
+    before sorting and both spellings share one cache entry.
 
     The stored entry is verified by structural equality on lookup
     (Term.__eq__), so a hash collision between different constraint sets
     cannot alias their sat/unsat verdicts (round-2 verdict weak #6; the
     reference caches by constraint-tuple equality, support/model.py:63)."""
     try:
-        return tuple(sorted(terms_list, key=hash))
+        return tuple(sorted(dict.fromkeys(terms_list), key=hash))
     except TypeError:
         return None
+
+
+# -- solve-service glue (mythril_tpu/service/) ------------------------------
+
+
+def _memory_tier_enabled() -> bool:
+    from mythril_tpu.service import memory_tier_enabled
+
+    return memory_tier_enabled()
+
+
+def _persistent_store():
+    """The on-disk result store, or None when the disk tier is off."""
+    from mythril_tpu.service import disk_tier_enabled
+
+    if not disk_tier_enabled():
+        return None
+    from mythril_tpu.service.store import get_result_store
+
+    store = get_result_store()
+    return store if store.available else None
+
+
+def _probe_persistent(solver, prep, crosscheck, stats):
+    """Disk-tier lookup for a blasted instance.
+
+    Returns (fingerprint, outcome): outcome is ("sat", Model, True) /
+    ("unsat", None, memoizable) on a trusted hit, None on a miss;
+    fingerprint is None when the disk tier is off or the instance cannot
+    be fingerprinted (callers reuse it to store the eventual verdict).
+
+    A SAT entry is replay-verified: the stored assignment bits are pushed
+    through Solver._reconstruct, which validates the rebuilt model against
+    the ORIGINAL constraints — a fingerprint collision or corrupted entry
+    degrades to a safe miss, never a wrong verdict. An UNSAT entry is only
+    trusted on the detection path when it carries crosscheck provenance;
+    an UNprovenanced entry trusted on the engine path must NOT be
+    memoized into the memory tier (memoizable=False) — a memory-tier
+    UNSAT is final even in a detection context, which would silently
+    bypass the provenance gate for the rest of the process."""
+    store = _persistent_store()
+    if store is None:
+        return None, None
+    from mythril_tpu.service.fingerprint import instance_fingerprint
+
+    fingerprint = instance_fingerprint(prep)
+    if fingerprint is None:
+        return None, None
+    entry = store.lookup(fingerprint)
+    if entry is None:
+        stats.add_persistent_lookup(hit=False)
+        return fingerprint, None
+    if entry.verdict == "sat":
+        if entry.num_vars != prep.num_vars:
+            stats.add_persistent_verify_reject()
+            stats.add_persistent_lookup(hit=False)
+            return fingerprint, None
+        try:
+            model = solver._reconstruct(prep, entry.bits)
+        except Exception:
+            stats.add_persistent_verify_reject()
+            stats.add_persistent_lookup(hit=False)
+            return fingerprint, None
+        stats.add_persistent_lookup(hit=True)
+        return fingerprint, ("sat", model, True)
+    if crosscheck and not entry.crosschecked:
+        # detection-critical lookup, entry never got its second opinion:
+        # re-solve (and re-store with provenance) instead of trusting it
+        stats.add_persistent_lookup(hit=False)
+        return fingerprint, None
+    stats.add_persistent_lookup(hit=True)
+    return fingerprint, ("unsat", None, entry.crosschecked)
+
+
+def _crosscheck_confirmed(crosscheck: bool) -> bool:
+    """Whether the just-settled UNSAT verdict's crosscheck actually RAN
+    and positively re-proved UNSAT on the permuted instance.
+
+    Provenance must record confirmed, not requested: a cap-skipped
+    crosscheck (instance past CROSSCHECK_CLAUSE_CAP) or an inconclusive
+    timed-out re-solve keeps the verdict in-process but must not be
+    persisted as a second opinion — later detection-path runs would trust
+    a never-netted verdict forever, on exactly the heaviest cones where a
+    CDCL bug is most likely to hide. sat_backend records the outcome of
+    the most recent crosscheck; read immediately after the settle."""
+    if not crosscheck:
+        return False
+    from mythril_tpu.smt.solver import sat_backend
+
+    return sat_backend.last_crosscheck_confirmed()
+
+
+def _persist_result(fingerprint, prep, status, bits=None,
+                    crosscheck=False, stats=None) -> None:
+    """Write a settled verdict into the disk tier (no-op when off)."""
+    if fingerprint is None:
+        return
+    store = _persistent_store()
+    if store is None:
+        return
+    if status == SAT:
+        stored = store.store_sat(fingerprint, prep.num_vars, bits)
+    elif status == UNSAT:
+        stored = store.store_unsat(
+            fingerprint, crosschecked=_crosscheck_confirmed(crosscheck))
+    else:
+        return
+    if stored and stats is not None:
+        stats.add_persistent_store()
 
 
 def get_model(
@@ -126,11 +242,13 @@ def get_model(
         timeout_s = min(timeout_s, max(time_handler.time_remaining() - 0.5, 0.05))
 
     crosscheck = _crosscheck_wanted()
+    stats = SolverStatistics()
     key = None
     if not minimize and not maximize:
-        key = _cache_key(raw_constraints)
+        key = _cache_key(raw_constraints) if _memory_tier_enabled() else None
         if key is not None and key in _result_cache:
             cached = _result_cache[key]
+            stats.add_memory_hit()
             if isinstance(cached, Model):
                 return cached
             # cached UNSAT is final even in a detection context: it came
@@ -140,6 +258,12 @@ def get_model(
             raise UnsatError()
         quick = model_cache.check_quick_sat(raw_constraints)
         if quick is not None:
+            stats.add_quick_sat_hit()
+            if key is not None:
+                # memoize the probe hit under the term key: without this
+                # the same constraint set re-scans the model deque on
+                # every call
+                _store_result(key, quick)
             return quick
 
     if minimize or maximize:
@@ -148,25 +272,74 @@ def get_model(
             solver.minimize(m.raw if isinstance(m, Expression) else m)
         for m in maximize:
             solver.maximize(m.raw if isinstance(m, Expression) else m)
-    else:
-        solver = Solver(timeout=timeout_s)
+        solver.unsat_crosscheck = crosscheck
+        solver.add(raw_constraints)
+        status = solver.check()
+        if capture_sink is not None and getattr(solver, "last_prep", None):
+            capture_sink.append((solver.last_prep, status))
+        if status == SAT:
+            return solver.model()
+        if status == UNSAT:
+            raise UnsatError()
+        raise SolverTimeOutException()
+
+    # plain (cacheable) path: prepare first so the disk tier can be probed
+    # by the blasted instance's content fingerprint before any real solve
+    solver = Solver(timeout=timeout_s)
     solver.unsat_crosscheck = crosscheck
     solver.add(raw_constraints)
+    start = time.monotonic()
+    try:
+        prep = solver._prepare([])
+        if prep.trivial is not None:
+            if prep.trivial == SAT:
+                model = solver._trivial_model(prep)
+                if key is not None:
+                    _store_result(key, model)
+                    # feed the quick-sat probe deque too (the pre-service
+                    # SAT tail did): trivial models often satisfy sibling
+                    # queries with different keys
+                    model_cache.put(model)
+                return model
+            if prep.trivial == UNSAT:
+                if key is not None:
+                    _store_result(key, UNSAT)
+                raise UnsatError()
+            raise SolverTimeOutException()
 
-    status = solver.check()
-    if capture_sink is not None and getattr(solver, "last_prep", None):
-        capture_sink.append((solver.last_prep, status))
-    if status == SAT:
-        model = solver.model()
-        if key is not None:
-            _store_result(key, model)
-            model_cache.put(model)
-        return model
-    if status == UNSAT:
-        if key is not None:
-            _store_result(key, UNSAT)
-        raise UnsatError()
-    raise SolverTimeOutException()
+        fingerprint, cached_outcome = _probe_persistent(
+            solver, prep, crosscheck, stats)
+        if cached_outcome is not None:
+            verdict, model, memoizable = cached_outcome
+            if verdict == "sat":
+                if key is not None:
+                    _store_result(key, model)
+                model_cache.put(model)
+                return model
+            if key is not None and memoizable:
+                _store_result(key, UNSAT)
+            raise UnsatError()
+
+        status = solver._solve_prepared(prep)
+        if capture_sink is not None:
+            capture_sink.append((prep, status))
+        if status == SAT:
+            model = solver.model()
+            if key is not None:
+                _store_result(key, model)
+                model_cache.put(model)
+            _persist_result(fingerprint, prep, SAT, bits=prep.last_bits,
+                            crosscheck=crosscheck, stats=stats)
+            return model
+        if status == UNSAT:
+            if key is not None:
+                _store_result(key, UNSAT)
+            _persist_result(fingerprint, prep, UNSAT,
+                            crosscheck=crosscheck, stats=stats)
+            raise UnsatError()
+        raise SolverTimeOutException()
+    finally:
+        stats.add_query(time.monotonic() - start)
 
 
 def get_models_batch(
@@ -194,7 +367,6 @@ def get_models_batch(
     same policy as get_model).
     """
     from mythril_tpu.smt.solver.frontend import Solver
-    from mythril_tpu.smt.solver.statistics import SolverStatistics
 
     stats = SolverStatistics()
     results: List = [None] * len(constraint_sets)
@@ -206,21 +378,28 @@ def get_models_batch(
     if enforce_execution_time:
         timeout_s = min(timeout_s, max(time_handler.time_remaining() - 0.5, 0.05))
 
-    pending: List[tuple] = []  # (idx, key, solver, prep)
+    use_memory_tier = _memory_tier_enabled()
+    pending: List[tuple] = []  # (idx, key, fingerprint, solver, prep)
     start = time.monotonic()
     for idx, constraints in enumerate(constraint_sets):
         raw_constraints = [
             c.raw if isinstance(c, Expression) else c for c in constraints
         ]
-        key = _cache_key(raw_constraints)
+        key = _cache_key(raw_constraints) if use_memory_tier else None
         if key is not None and key in _result_cache:
             cached = _result_cache[key]
+            stats.add_memory_hit()
             results[idx] = (
                 ("sat", cached) if isinstance(cached, Model) else ("unsat", None)
             )
             continue
         quick = model_cache.check_quick_sat(raw_constraints)
         if quick is not None:
+            stats.add_quick_sat_hit()
+            if key is not None:
+                # memoize the probe hit (same policy as get_model): the
+                # next lookup hits the term-keyed tier, not a deque scan
+                _store_result(key, quick)
             results[idx] = ("sat", quick)
             continue
         solver = Solver(timeout=timeout_s)
@@ -241,13 +420,27 @@ def get_models_batch(
             else:
                 results[idx] = ("unknown", None)
             continue
-        pending.append((idx, key, solver, prep))
+        fingerprint, cached_outcome = _probe_persistent(
+            solver, prep, crosscheck, stats)
+        if cached_outcome is not None:
+            verdict, model, memoizable = cached_outcome
+            if verdict == "sat":
+                results[idx] = ("sat", model)
+                if key is not None:
+                    _store_result(key, model)
+                model_cache.put(model)
+            else:
+                results[idx] = ("unsat", None)
+                if key is not None and memoizable:
+                    _store_result(key, UNSAT)
+            continue
+        pending.append((idx, key, fingerprint, solver, prep))
 
     if pending and args.solver_backend == "tpu":
         eligible = []
         ineligible = []
         for entry in pending:
-            prep = entry[3]
+            prep = entry[4]
             has_empty = (
                 prep.clauses.has_empty
                 if hasattr(prep.clauses, "has_empty")
@@ -270,7 +463,7 @@ def get_models_batch(
             # solves (tpu/circuit.py).
             problems = [
                 (p.num_vars, p.clauses, p.aig_roots)
-                for _, _, _, p in eligible
+                for _, _, _, _, p in eligible
             ]
             bits_list = get_router().dispatch(problems, timeout_s, stats)
         except Exception as error:
@@ -280,25 +473,28 @@ def get_models_batch(
                 "batched device solve failed (%s); CDCL fallback", error)
             bits_list = [None] * len(eligible)
         still_pending = list(ineligible)
-        for (idx, key, solver, prep), bits in zip(eligible, bits_list):
+        for (idx, key, fingerprint, solver, prep), bits in \
+                zip(eligible, bits_list):
             stats.add_device_batch_query(hit=bits is not None)
             if bits is None:
-                still_pending.append((idx, key, solver, prep))
+                still_pending.append((idx, key, fingerprint, solver, prep))
                 continue
             try:
                 model = solver._reconstruct(prep, bits)
             except Exception:
-                still_pending.append((idx, key, solver, prep))
+                still_pending.append((idx, key, fingerprint, solver, prep))
                 continue
             results[idx] = ("sat", model)
             if key is not None:
                 _store_result(key, model)
                 model_cache.put(model)
+            _persist_result(fingerprint, prep, SAT, bits=bits,
+                            crosscheck=crosscheck, stats=stats)
         pending = still_pending
 
     # CDCL settles the rest (and proves UNSAT); plain path, no device re-entry
     settle_start = time.monotonic()
-    for idx, key, solver, prep in pending:
+    for idx, key, fingerprint, solver, prep in pending:
         solver.allow_device = False
         solver.unsat_crosscheck = crosscheck
         solver.timeout = max(0.05, timeout_s - (time.monotonic() - start))
@@ -311,10 +507,14 @@ def get_models_batch(
             if key is not None:
                 _store_result(key, model)
                 model_cache.put(model)
+            _persist_result(fingerprint, prep, SAT, bits=prep.last_bits,
+                            crosscheck=crosscheck, stats=stats)
         elif status == UNSAT:
             results[idx] = ("unsat", None)
             if key is not None:
                 _store_result(key, UNSAT)
+            _persist_result(fingerprint, prep, UNSAT,
+                            crosscheck=crosscheck, stats=stats)
         else:
             results[idx] = ("unknown", None)
     stats.add_host_route_seconds(time.monotonic() - settle_start)
@@ -331,3 +531,9 @@ def _store_result(key, value) -> None:
 def clear_caches() -> None:
     _result_cache.clear()
     model_cache.models.clear()
+    # service layer: buffered scheduler state is discarded and the
+    # persistent-store handle released, so tests and --jobs workers start
+    # clean — a cleared process re-populates from disk, not stale memory
+    from mythril_tpu.service import reset_service_state
+
+    reset_service_state()

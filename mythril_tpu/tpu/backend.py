@@ -112,10 +112,16 @@ def _enable_compile_cache(jax) -> None:
     if _cache_enabled:
         return
     try:
-        cache_dir = os.environ.get(
-            "MYTHRIL_TPU_JIT_CACHE",
-            os.path.join(os.path.expanduser("~"), ".cache", "mythril_tpu_xla"),
-        )
+        cache_dir = os.environ.get("MYTHRIL_TPU_JIT_CACHE")
+        if cache_dir is None:
+            # co-locate with the solve-service store when the operator
+            # pinned a cache root: one MYTHRIL_TPU_CACHE_DIR carries every
+            # persistent artifact (results, calibration, XLA executables)
+            service_root = os.environ.get("MYTHRIL_TPU_CACHE_DIR")
+            cache_dir = (
+                os.path.join(service_root, "xla") if service_root
+                else os.path.join(os.path.expanduser("~"), ".cache",
+                                  "mythril_tpu_xla"))
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

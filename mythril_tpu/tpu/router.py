@@ -214,18 +214,37 @@ class QueryRouter:
         return int(self.round_budget_s / per_round_level)
 
     def _calibrate(self) -> bool:
-        """Measure per-cell ministep latency once per process."""
+        """Measure per-cell ministep latency once per process — or load the
+        persisted measurement (service/calibration.py) so repeated CLI
+        invocations skip the measurement round entirely."""
         if self._calibrated:
             return self._per_cell_s is not None
         self._calibrated = True
         if os.environ.get("MYTHRIL_TPU_CALIBRATE", "") == "0":
             return False
+        from mythril_tpu.service.calibration import (
+            load_per_cell_latency,
+            save_per_cell_latency,
+        )
+
+        platform = self._platform()
+        restarts = self._profile_restarts()
+        steps = self._profile_steps()
+        cached = load_per_cell_latency(platform, restarts, steps)
+        if cached is not None:
+            self._per_cell_s = cached
+            log.info("device micro-calibration: %.1fns/cell-ministep "
+                     "(persistent cache, measurement skipped)",
+                     cached * 1e9)
+            return True
         try:
             start = time.monotonic()
             self._per_cell_s = self._measure_round_latency()
             log.info("device micro-calibration: %.1fns/cell-ministep "
                      "(%.2fs total)", self._per_cell_s * 1e9,
                      time.monotonic() - start)
+            save_per_cell_latency(platform, restarts, steps,
+                                  self._per_cell_s)
             return True
         except Exception as error:
             log.info("device micro-calibration failed (%s); "
@@ -259,9 +278,7 @@ class QueryRouter:
         # measure at the restart batch the active profile will dispatch
         # with: restart lanes serialize on the CPU platform, so measuring
         # at the full production batch would overstate dispatch cost 4-8x
-        restarts = self.backend.num_restarts
-        if self._evidence_mode():
-            restarts = min(restarts, self.CPU_PROFILE_RESTARTS)
+        restarts = self._profile_restarts()
         x = jax.random.bernoulli(
             jax.random.PRNGKey(0), 0.5, (1, restarts, pc.v1)
         ).astype(jax.numpy.int32)
@@ -284,6 +301,17 @@ class QueryRouter:
         if self._evidence_mode():
             return self.CPU_PROFILE_STEPS
         return self.backend.CIRCUIT_STEPS
+
+    def _profile_restarts(self) -> int:
+        """Restart lanes the active profile dispatches (and calibration
+        measures) with — also the cell-profile key of the persistent
+        calibration cache: restart lanes serialize on the CPU platform, so
+        measuring at the full production batch would overstate dispatch
+        cost 4-8x."""
+        restarts = self.backend.num_restarts
+        if self._evidence_mode():
+            restarts = min(restarts, self.CPU_PROFILE_RESTARTS)
+        return restarts
 
     def est_round_seconds(self, levels: int, width: int = 1024) -> float:
         """Cost-model estimate of ONE kernel round over a levels x width

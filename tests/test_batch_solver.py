@@ -134,17 +134,22 @@ def test_batch_device_unsat_falls_to_cdcl(monkeypatch):
 
 
 def test_pending_strategy_drains_in_one_batch(monkeypatch):
-    """DelayConstraintStrategy revives parked states via get_models_batch."""
+    """DelayConstraintStrategy revives parked states through the coalescing
+    scheduler, whose flush lands in ONE get_models_batch call."""
     from mythril_tpu.laser.strategy import constraint_strategy as cs
+    from mythril_tpu.support import model as model_mod
 
     calls = []
-    real = cs.get_models_batch
+    real = model_mod.get_models_batch
 
     def spy(sets, **kw):
         calls.append(len(sets))
         return real(sets, **kw)
 
-    monkeypatch.setattr(cs, "get_models_batch", spy)
+    # the scheduler flush resolves get_models_batch from support.model at
+    # call time — patch it there (the seam itself now goes via the
+    # scheduler, with or without coalescing enabled)
+    monkeypatch.setattr(model_mod, "get_models_batch", spy)
 
     class FakeConstraints:
         def __init__(self, cons):

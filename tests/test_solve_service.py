@@ -1,0 +1,458 @@
+"""Tiered solve-result service (mythril_tpu/service/): persistent
+cross-run store, replay verification, coalescing scheduler, and the
+satellite cache-policy fixes in support/model.py."""
+
+import json
+import os
+
+import pytest
+
+from mythril_tpu.service.scheduler import get_scheduler
+from mythril_tpu.service.store import PersistentResultStore, get_result_store
+from mythril_tpu.smt import symbol_factory
+from mythril_tpu.smt.solver import sat_backend
+from mythril_tpu.smt.solver.frontend import Solver, UnsatError
+from mythril_tpu.smt.solver.statistics import SolverStatistics
+from mythril_tpu.support import model as model_mod
+from mythril_tpu.support.model import (
+    _cache_key,
+    clear_caches,
+    get_model,
+    get_models_batch,
+)
+from mythril_tpu.support.args import args
+
+
+@pytest.fixture(autouse=True)
+def _clean(tmp_path, monkeypatch):
+    """Fresh stats, an isolated cache dir, and clean service state around
+    every test; solve_cache restored to its default afterwards."""
+    monkeypatch.setenv("MYTHRIL_TPU_CACHE_DIR", str(tmp_path))
+    monkeypatch.delenv("MYTHRIL_TPU_COALESCE_MS", raising=False)
+    monkeypatch.delenv("MYTHRIL_TPU_COALESCE_MAX", raising=False)
+    stats = SolverStatistics()
+    stats.reset()
+    stats.enabled = True
+    clear_caches()
+    saved_mode = args.solve_cache
+    yield
+    args.solve_cache = saved_mode
+    clear_caches()
+    stats.reset()
+    stats.enabled = False
+
+
+def _sat_constraints(tag: str):
+    # survives word-level preprocessing (interval + square): a real blast
+    x = symbol_factory.BitVecSym(f"svc_{tag}", 64)
+    return [x * x > 100, x < 50, x > 40]
+
+
+def _unsat_constraints(tag: str):
+    x = symbol_factory.BitVecSym(f"svcu_{tag}", 64)
+    return [x * x > 100, x < 2, x > 0]
+
+
+def _store_dir(tmp_path):
+    return os.path.join(str(tmp_path), "solve-cache")
+
+
+# -- satellite: _cache_key term dedup ---------------------------------------
+
+
+def test_cache_key_dedups_repeated_terms():
+    x = symbol_factory.BitVecSym("dedup_x", 64)
+    a = (x > 3).raw
+    b = (x < 9).raw
+    assert _cache_key([a, a]) == _cache_key([a])
+    assert _cache_key([a, b, a]) == _cache_key([b, a])
+    assert _cache_key([a]) != _cache_key([b])
+
+
+# -- satellite: quick-sat probe hits are memoized ---------------------------
+
+
+def test_quick_sat_hit_is_stored_under_its_key():
+    constraints = _sat_constraints("quick")
+    model = get_model(constraints)
+    # drop the term-keyed tier but keep the recent-model deque
+    model_mod._result_cache.clear()
+    assert model_mod.model_cache.check_quick_sat(
+        [c.raw for c in constraints]) is not None
+    stats = SolverStatistics()
+    again = get_model(constraints)
+    assert again.assignment == model.assignment
+    assert stats.quick_sat_hits == 1
+    key = _cache_key([c.raw for c in constraints])
+    assert key in model_mod._result_cache  # memoized: no more deque scans
+    get_model(constraints)
+    assert stats.memory_hits == 1  # second call hits the term-keyed tier
+
+
+# -- persistent tier --------------------------------------------------------
+
+
+def test_persistent_sat_roundtrip_across_clear(tmp_path):
+    args.solve_cache = "disk"
+    constraints = _sat_constraints("roundtrip")
+    cold = get_model(constraints)
+    stats = SolverStatistics()
+    assert stats.persistent_stores == 1
+    clear_caches()  # drops memory tiers + service handles, keeps the disk
+    stats.enabled = True
+    settles_before = stats.cdcl_settles
+    warm = get_model(constraints)
+    assert warm.assignment == cold.assignment
+    assert stats.persistent_hits == 1
+    # the whole point: the warm verdict came from disk, not a re-solve
+    assert stats.cdcl_settles == settles_before
+
+
+def test_persistent_corrupted_entry_is_a_safe_miss(tmp_path):
+    """A corrupted SAT entry (wrong assignment bits) must fail replay
+    verification and degrade to a miss — the correct model still comes
+    back from a real solve, never a wrong verdict from the store."""
+    args.solve_cache = "disk"
+    constraints = _sat_constraints("corrupt")
+    cold = get_model(constraints)
+    store_dir = _store_dir(tmp_path)
+    entries = [name for name in os.listdir(store_dir)
+               if name.endswith(".json")]
+    assert len(entries) == 1
+    path = os.path.join(store_dir, entries[0])
+    with open(path) as fd:
+        payload = json.load(fd)
+    # plant an all-zero assignment of the right length: decodes fine,
+    # fails Model validation on replay (x=0 violates x > 40)
+    from mythril_tpu.service.store import _pack_bits
+
+    payload["bits"] = _pack_bits([False] * (payload["num_vars"] + 1))
+    with open(path, "w") as fd:
+        json.dump(payload, fd)
+    clear_caches()
+    stats = SolverStatistics()
+    stats.enabled = True
+    model = get_model(constraints)
+    assert model.assignment == cold.assignment  # correct verdict re-solved
+    assert stats.persistent_verify_rejects == 1
+    assert stats.persistent_hits == 0
+
+
+def test_persistent_unsat_provenance_gates_detection_trust(monkeypatch):
+    """An engine-path UNSAT entry carries no crosscheck provenance: a
+    detection-context lookup must NOT trust it (re-solve + crosscheck,
+    which re-stores the entry WITH provenance); after that the
+    detection-context lookup hits."""
+    args.solve_cache = "disk"
+    calls = {"n": 0}
+    original = sat_backend._crosscheck_unsat
+
+    def counting(*c_args, **c_kwargs):
+        calls["n"] += 1
+        return original(*c_args, **c_kwargs)
+
+    monkeypatch.setattr(sat_backend, "_crosscheck_unsat", counting)
+    constraints = _unsat_constraints("prov")
+    with pytest.raises(UnsatError):
+        get_model(constraints)  # engine path: stored without provenance
+    assert calls["n"] == 0
+
+    clear_caches()
+    with model_mod.detection_context():
+        with pytest.raises(UnsatError):
+            get_model(constraints)  # unprovenanced entry: re-solved
+    assert calls["n"] == 1
+
+    clear_caches()
+    stats = SolverStatistics()
+    stats.enabled = True
+    with model_mod.detection_context():
+        with pytest.raises(UnsatError):
+            get_model(constraints)  # provenance-carrying entry: trusted
+    assert calls["n"] == 1
+    assert stats.persistent_hits == 1
+
+
+def test_cap_skipped_crosscheck_is_not_stored_as_provenance(monkeypatch):
+    """Provenance records a crosscheck that RAN, not one that was merely
+    requested: a cap-skipped crosscheck (instance past
+    CROSSCHECK_CLAUSE_CAP) must store crosschecked=False, so detection
+    lookups keep re-solving instead of trusting a never-netted verdict."""
+    args.solve_cache = "disk"
+    monkeypatch.setattr(sat_backend, "CROSSCHECK_CLAUSE_CAP", 1)
+    constraints = _unsat_constraints("capskip")
+    with model_mod.detection_context():
+        with pytest.raises(UnsatError):
+            get_model(constraints)  # crosscheck requested but cap-skipped
+    clear_caches()
+    stats = SolverStatistics()
+    stats.enabled = True
+    with model_mod.detection_context():
+        with pytest.raises(UnsatError):
+            get_model(constraints)
+    assert stats.persistent_hits == 0  # unprovenanced entry: not trusted
+
+
+def test_unprovenanced_disk_hit_does_not_seed_memory_tier(monkeypatch):
+    """An engine-path hit on an UNprovenanced disk UNSAT must not be
+    memoized into the memory tier: a memory-tier UNSAT is final even in a
+    detection context, which would bypass the provenance gate for the rest
+    of the process."""
+    args.solve_cache = "disk"
+    calls = {"n": 0}
+    original = sat_backend._crosscheck_unsat
+
+    def counting(*c_args, **c_kwargs):
+        calls["n"] += 1
+        return original(*c_args, **c_kwargs)
+
+    monkeypatch.setattr(sat_backend, "_crosscheck_unsat", counting)
+    constraints = _unsat_constraints("seed")
+    with pytest.raises(UnsatError):
+        get_model(constraints)  # engine solve: stored unprovenanced
+    clear_caches()
+    with pytest.raises(UnsatError):
+        get_model(constraints)  # engine path trusts the disk entry...
+    key = _cache_key([c.raw for c in constraints])
+    assert key not in model_mod._result_cache  # ...but must not memoize it
+    with model_mod.detection_context():
+        with pytest.raises(UnsatError):
+            get_model(constraints)  # same process: provenance gate intact
+    assert calls["n"] == 1  # detection lookup re-solved with the crosscheck
+
+
+def test_persistent_unsat_trusted_on_engine_path_without_provenance():
+    args.solve_cache = "disk"
+    constraints = _unsat_constraints("engine")
+    with pytest.raises(UnsatError):
+        get_model(constraints)
+    clear_caches()
+    stats = SolverStatistics()
+    stats.enabled = True
+    settles_before = stats.cdcl_settles
+    with pytest.raises(UnsatError):
+        get_model(constraints)  # engine path trusts the plain entry
+    assert stats.persistent_hits == 1
+    assert stats.cdcl_settles == settles_before
+
+
+def test_solve_cache_off_disables_result_tiers():
+    args.solve_cache = "off"
+    constraints = _sat_constraints("off")
+    get_model(constraints)
+    assert not model_mod._result_cache  # nothing cached under off
+    stats = SolverStatistics()
+    assert stats.persistent_stores == 0
+
+
+def test_get_models_batch_hits_persistent_tier(tmp_path):
+    args.solve_cache = "disk"
+    sat_set = _sat_constraints("batch")
+    unsat_set = _unsat_constraints("batch")
+    cold = get_models_batch([sat_set, unsat_set])
+    assert [status for status, _ in cold] == ["sat", "unsat"]
+    clear_caches()
+    stats = SolverStatistics()
+    stats.enabled = True
+    settles_before = stats.cdcl_settles
+    warm = get_models_batch([sat_set, unsat_set])
+    assert [status for status, _ in warm] == ["sat", "unsat"]
+    assert stats.persistent_hits == 2
+    assert stats.cdcl_settles == settles_before
+
+
+def test_store_schema_bump_invalidates_entries(tmp_path, monkeypatch):
+    args.solve_cache = "disk"
+    constraints = _sat_constraints("schema")
+    get_model(constraints)
+    store_dir = _store_dir(tmp_path)
+    assert any(name.endswith(".json") for name in os.listdir(store_dir))
+    clear_caches()
+    from mythril_tpu.service import store as store_mod
+
+    monkeypatch.setattr(store_mod, "STORE_SCHEMA_VERSION", 999)
+    fresh = PersistentResultStore(root=store_dir)
+    assert fresh.entry_count() == 0  # old-schema entries wiped
+
+
+def test_store_lru_eviction_caps_entries(tmp_path):
+    store = PersistentResultStore(root=str(tmp_path / "lru"), max_entries=4)
+    for i in range(8):
+        assert store.store_unsat(f"{i:064x}", crosschecked=False)
+    assert store.entry_count() <= 4
+    # the most recent writes survive
+    assert store.lookup(f"{7:064x}") is not None
+    assert store.lookup(f"{0:064x}") is None
+
+
+def test_clear_caches_resets_service_handles():
+    args.solve_cache = "disk"
+    first = get_result_store()
+    scheduler = get_scheduler()
+    handle = scheduler.submit(_sat_constraints("clear")) \
+        if scheduler.enabled else None
+    clear_caches()
+    assert get_result_store() is not first  # handle re-opened from disk
+    if handle is not None:
+        # buffered state was discarded, not solved
+        assert handle.done
+        assert handle.result()[0] == "unknown"
+
+
+# -- fingerprint ------------------------------------------------------------
+
+
+def test_fingerprint_stable_across_solver_objects():
+    from mythril_tpu.service.fingerprint import instance_fingerprint
+
+    def blast(tag_suffix=""):
+        x = symbol_factory.BitVecSym("fp_x", 64)
+        solver = Solver()
+        solver.add(x * x > 100, x < 50, x > 40)
+        return instance_fingerprint(solver._prepare([]))
+
+    first, second = blast(), blast()
+    assert first is not None and first == second
+
+    y = symbol_factory.BitVecSym("fp_y", 64)
+    other = Solver()
+    other.add(y * y > 100, y < 51, y > 40)
+    assert instance_fingerprint(other._prepare([])) != first
+
+
+# -- persistent calibration cache -------------------------------------------
+
+
+def test_calibration_roundtrip_and_gating(tmp_path):
+    from mythril_tpu.service.calibration import (
+        load_per_cell_latency,
+        save_per_cell_latency,
+    )
+
+    args.solve_cache = "disk"
+    assert load_per_cell_latency("cpu", 8, 32) is None
+    save_per_cell_latency("cpu", 8, 32, 5e-8)
+    assert load_per_cell_latency("cpu", 8, 32) == pytest.approx(5e-8)
+    assert load_per_cell_latency("cpu", 16, 32) is None  # other profile
+    args.solve_cache = "memory"
+    assert load_per_cell_latency("cpu", 8, 32) is None  # disk tier off
+
+
+def test_router_calibration_skips_measurement_on_cache_hit(monkeypatch):
+    from mythril_tpu.service.calibration import save_per_cell_latency
+    from mythril_tpu.tpu import router as router_mod
+
+    args.solve_cache = "disk"
+    router_mod.reset_router()
+    try:
+        router = router_mod.get_router()
+        platform = router._platform()
+        if platform is None:
+            pytest.skip("jax unavailable")
+        save_per_cell_latency(platform, router._profile_restarts(),
+                              router._profile_steps(), 7e-8)
+
+        def boom(self):
+            raise AssertionError("measurement must be skipped on a hit")
+
+        monkeypatch.setattr(router_mod.QueryRouter,
+                            "_measure_round_latency", boom)
+        assert router._calibrate() is True
+        assert router._per_cell_s == pytest.approx(7e-8)
+    finally:
+        router_mod.reset_router()
+
+
+# -- coalescing scheduler ---------------------------------------------------
+
+
+def test_scheduler_coalesces_submissions_into_one_flush(monkeypatch):
+    monkeypatch.setenv("MYTHRIL_TPU_COALESCE_MS", "1000")
+    monkeypatch.setenv("MYTHRIL_TPU_COALESCE_MAX", "8")
+    clear_caches()  # re-read env into a fresh scheduler
+    stats = SolverStatistics()
+    stats.enabled = True
+    scheduler = get_scheduler()
+    handles = [
+        scheduler.submit(_sat_constraints(f"co{i}")) for i in range(3)
+    ]
+    assert scheduler.pending() == 3
+    assert not any(h.done for h in handles)
+    status, model = handles[0].result()  # first demand flushes the cohort
+    assert status == "sat" and model is not None
+    assert all(h.done for h in handles)
+    assert stats.window_flushes == 1
+    assert stats.coalesced_queries == 3
+    assert stats.coalesce_occupancy == 3.0
+
+
+def test_scheduler_max_batch_triggers_flush(monkeypatch):
+    monkeypatch.setenv("MYTHRIL_TPU_COALESCE_MS", "1000")
+    monkeypatch.setenv("MYTHRIL_TPU_COALESCE_MAX", "2")
+    clear_caches()
+    scheduler = get_scheduler()
+    first = scheduler.submit(_sat_constraints("max0"))
+    assert not first.done
+    second = scheduler.submit(_sat_constraints("max1"))
+    assert first.done and second.done  # count trigger, no demand needed
+    assert scheduler.pending() == 0
+
+
+def test_scheduler_window_age_triggers_flush(monkeypatch):
+    import time
+
+    monkeypatch.setenv("MYTHRIL_TPU_COALESCE_MS", "5")
+    clear_caches()
+    scheduler = get_scheduler()
+    first = scheduler.submit(_sat_constraints("age0"))
+    time.sleep(0.02)
+    scheduler.submit(_sat_constraints("age1"))
+    assert first.done  # the stale cohort flushed before the new one opened
+
+
+def test_scheduler_disabled_is_passthrough(monkeypatch):
+    monkeypatch.setenv("MYTHRIL_TPU_COALESCE_MS", "0")
+    clear_caches()
+    stats = SolverStatistics()
+    stats.enabled = True
+    scheduler = get_scheduler()
+    assert not scheduler.enabled
+    handle = scheduler.submit(_sat_constraints("pass"))
+    assert handle.done  # solved immediately, nothing buffered
+    assert handle.result()[0] == "sat"
+    outcomes = scheduler.solve_batch(
+        [_sat_constraints("pb"), _unsat_constraints("pb")])
+    assert [status for status, _ in outcomes] == ["sat", "unsat"]
+    assert stats.window_flushes == 0  # no windows recorded when disabled
+
+
+def test_scheduler_solve_batch_never_splits_a_bundle(monkeypatch):
+    """A seam bundle larger than MYTHRIL_TPU_COALESCE_MAX still rides ONE
+    get_models_batch call (the pre-service batching granularity): only
+    direct submit() traffic is count-flushed."""
+    monkeypatch.setenv("MYTHRIL_TPU_COALESCE_MS", "1000")
+    monkeypatch.setenv("MYTHRIL_TPU_COALESCE_MAX", "2")
+    clear_caches()
+    calls = []
+    real = model_mod.get_models_batch
+
+    def spy(sets, **kwargs):
+        calls.append(len(sets))
+        return real(sets, **kwargs)
+
+    monkeypatch.setattr(model_mod, "get_models_batch", spy)
+    sets = [_sat_constraints(f"bundle{i}") for i in range(5)]
+    outcomes = get_scheduler().solve_batch(sets, crosscheck=False)
+    assert [status for status, _ in outcomes] == ["sat"] * 5
+    assert calls == [5]
+
+
+def test_scheduler_solve_batch_matches_get_models_batch(monkeypatch):
+    monkeypatch.setenv("MYTHRIL_TPU_COALESCE_MS", "50")
+    clear_caches()
+    sets = [_sat_constraints("eq0"), _unsat_constraints("eq1"),
+            _sat_constraints("eq2")]
+    coalesced = get_scheduler().solve_batch(sets, crosscheck=False)
+    clear_caches()
+    direct = get_models_batch(sets, crosscheck=False)
+    assert [s for s, _ in coalesced] == [s for s, _ in direct]

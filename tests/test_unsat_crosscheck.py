@@ -150,6 +150,98 @@ def test_crosscheck_run_is_counted():
     stats.reset()
 
 
+def test_cached_unsat_policy_memory_vs_persistent(monkeypatch, tmp_path):
+    """Pin the two-tier cached-UNSAT x crosscheck policy side by side:
+
+    - MEMORY tier: a cached UNSAT is final even in a detection context
+      (it came from a completed CDCL solve THIS process; re-solving made
+      wall-clock-sensitive timeouts flip settled verdicts) — no provenance
+      gating, by design.
+    - PERSISTENT tier: an entry from ANOTHER run carries explicit
+      crosscheck provenance and a detection-context lookup only trusts it
+      when the provenance is there; otherwise it re-solves (and the
+      re-store upgrades the entry)."""
+    from mythril_tpu.support.args import args
+
+    monkeypatch.setenv("MYTHRIL_TPU_CACHE_DIR", str(tmp_path))
+    saved_mode = args.solve_cache
+    args.solve_cache = "disk"
+    try:
+        calls = _count_crosschecks(monkeypatch)
+        constraints = _unsat_constraints("2tier")
+        with pytest.raises(UnsatError):
+            get_model(constraints)  # engine path: no crosscheck
+        assert calls["n"] == 0
+        # memory tier: same process, detection context — final, no re-solve
+        with detection_context():
+            with pytest.raises(UnsatError):
+                get_model(constraints)
+        assert calls["n"] == 0
+        # persistent tier: "new process" (memory cleared), detection
+        # context — the unprovenanced entry is NOT trusted
+        model_mod.clear_caches()
+        with detection_context():
+            with pytest.raises(UnsatError):
+                get_model(constraints)
+        assert calls["n"] == 1
+        # the re-store carried provenance: the next cleared-process
+        # detection lookup trusts it without another crosscheck
+        model_mod.clear_caches()
+        with detection_context():
+            with pytest.raises(UnsatError):
+                get_model(constraints)
+        assert calls["n"] == 1
+    finally:
+        args.solve_cache = saved_mode
+
+
+def test_persistent_cache_across_invocations(tmp_path):
+    """Acceptance: a second identical analyze invocation with the disk
+    tier enabled reports persistent_hits > 0 and strictly fewer CDCL
+    settles than the cold run, with identical findings."""
+    import json
+    import subprocess
+    import sys
+
+    inputs = "/root/reference/tests/testdata/inputs"
+    if os.path.isdir(inputs):
+        input_path = os.path.join(inputs, "suicide.sol.o")
+    else:
+        # reference corpus not mounted: the hand-assembled suicide
+        # contract exercises the same end-to-end path
+        from tests.test_analysis import KILLBILLY, wrap_creation
+
+        input_path = str(tmp_path / "killbilly.hex")
+        with open(input_path, "w") as fd:
+            fd.write(wrap_creation(KILLBILLY))
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    legs = {}
+    for label in ("cold", "warm"):
+        stats_path = str(tmp_path / f"stats_{label}.json")
+        proc = subprocess.run(
+            [sys.executable, "-m", "mythril_tpu", "analyze",
+             "-f", input_path,
+             "-t", "1", "-o", "json", "--solver-timeout", "10000",
+             "--solve-cache", "disk"],
+            capture_output=True, text=True, timeout=600, cwd=repo,
+            env={**os.environ, "JAX_PLATFORMS": "cpu",
+                 "MYTHRIL_TPU_CACHE_DIR": str(tmp_path / "cache"),
+                 "MYTHRIL_TPU_STATS_JSON": stats_path},
+        )
+        output = json.loads(proc.stdout.strip().splitlines()[-1])
+        with open(stats_path) as fd:
+            stats = json.load(fd)
+        legs[label] = {
+            "issues": sorted(i["swc-id"] for i in output["issues"]),
+            "stats": stats,
+        }
+    assert legs["cold"]["issues"] == legs["warm"]["issues"] == ["106"]
+    assert legs["cold"]["stats"]["persistent_stores"] > 0
+    assert legs["warm"]["stats"]["persistent_hits"] > 0
+    assert (legs["warm"]["stats"]["cdcl_settles"]
+            < legs["cold"]["stats"]["cdcl_settles"])
+
+
 def test_prep_session_rejects_second_cnf_load():
     """Round-5 advisor #3: reloading a live session would solve under
     learnt clauses from the previous instance (unsound) — refused."""
