@@ -25,6 +25,15 @@ class DetectionModule:
     entry_point: EntryPoint = EntryPoint.CALLBACK
     pre_hooks: List[str] = []
     post_hooks: List[str] = []
+    # static-gating declaration (preanalysis): the opcodes at least one of
+    # which must be EXECUTABLE for this module to ever raise an issue.
+    # None (default) falls back to pre_hooks + post_hooks — always sound.
+    # Override with a tighter set when some hooks are mere taint
+    # observers: e.g. TxOrigin hooks JUMPI but cannot fire without ORIGIN
+    # having executed. Declaring an opcode here that is NOT required for
+    # an issue would be a soundness bug (findings would silently vanish
+    # on contracts lacking it).
+    trigger_opcodes: Optional[List[str]] = None
 
     def __init__(self):
         self.issues: List = []

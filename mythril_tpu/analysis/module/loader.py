@@ -28,7 +28,15 @@ class ModuleLoader:
         self,
         entry_point: Optional[EntryPoint] = None,
         white_list: Optional[List[str]] = None,
+        reachable_opcodes: Optional[frozenset] = None,
     ) -> List[DetectionModule]:
+        """`reachable_opcodes`, when given (preanalysis.gating_opcodes —
+        None means "no static information", gate nothing), statically
+        gates CALLBACK modules: a module whose trigger opcodes are all
+        unreachable in the analyzed bytecode can never fire a hook, so it
+        is not attached at all — no hooks, no predicate solves, no solver
+        traffic. Every gate is counted (`modules_gated`); POST modules
+        always run (they read the statespace, not opcode hooks)."""
         result = self._modules[:]
         if white_list:
             # accept both the reference's class names (`-m Exceptions`,
@@ -46,7 +54,31 @@ class ModuleLoader:
             result = [m for m in result if names_of(m) & wanted]
         if entry_point:
             result = [m for m in result if m.entry_point == entry_point]
+        if reachable_opcodes is not None:
+            result = self._gate_unreachable(result, reachable_opcodes)
         return result
+
+    @staticmethod
+    def _gate_unreachable(modules: List[DetectionModule],
+                          reachable_opcodes: frozenset
+                          ) -> List[DetectionModule]:
+        from mythril_tpu.analysis.module.util import module_trigger_opcodes
+        from mythril_tpu.smt.solver.statistics import SolverStatistics
+
+        kept = []
+        stats = SolverStatistics()
+        for module in modules:
+            if module.entry_point == EntryPoint.CALLBACK:
+                triggers = module_trigger_opcodes(module)
+                if triggers and not (triggers & reachable_opcodes):
+                    stats.add_module_gated()
+                    log.info(
+                        "preanalysis: gating module %s (trigger opcodes "
+                        "%s statically unreachable)",
+                        module.name, ",".join(sorted(triggers)))
+                    continue
+            kept.append(module)
+        return kept
 
     def _register_mythril_modules(self):
         from mythril_tpu.analysis.module.modules.arbitrary_jump import ArbitraryJump

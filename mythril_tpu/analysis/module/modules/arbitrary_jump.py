@@ -18,6 +18,10 @@ class ArbitraryJump(DetectionModule):
     description = "Caller can redirect execution to arbitrary bytecode locations."
     entry_point = EntryPoint.CALLBACK
     pre_hooks = ["JUMP", "JUMPI"]
+    # fires (and solves) ONLY on a symbolic jump destination — a cone the
+    # static CFG fully resolved (every target a push constant) cannot
+    # trigger it, so inert-cone analysis may ignore this module's hooks
+    symbolic_jump_only = True
 
     def _analyze_state(self, state):
         jump_dest = state.mstate.stack[-1]

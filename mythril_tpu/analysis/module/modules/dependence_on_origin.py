@@ -24,6 +24,8 @@ class TxOrigin(DetectionModule):
     entry_point = EntryPoint.CALLBACK
     pre_hooks = ["JUMPI"]
     post_hooks = ["ORIGIN"]
+    # JUMPI is only a taint OBSERVER: no issue without ORIGIN executing
+    trigger_opcodes = ["ORIGIN"]
 
     def _analyze_state(self, state) -> List[Issue]:
         if self.current_opcode == "ORIGIN":

@@ -27,6 +27,9 @@ class PredictableVariables(DetectionModule):
     entry_point = EntryPoint.CALLBACK
     pre_hooks = ["JUMPI"]
     post_hooks = PREDICTABLE_OPS
+    # JUMPI is only a taint OBSERVER: no issue without a predictable-value
+    # source opcode executing first
+    trigger_opcodes = PREDICTABLE_OPS
 
     def _analyze_state(self, state):
         if not self.is_prehook:

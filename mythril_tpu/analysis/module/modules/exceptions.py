@@ -82,6 +82,9 @@ class Exceptions(DetectionModule):
     description = DESCRIPTION_HEAD
     entry_point = EntryPoint.CALLBACK
     pre_hooks = ["INVALID", "JUMP", "REVERT"]
+    # JUMP only records the last-jump cache key; issues fire at
+    # INVALID (0.4-style assert) or panic-data REVERT (0.8 assert)
+    trigger_opcodes = ["INVALID", "REVERT"]
 
     def __init__(self):
         super().__init__()

@@ -123,6 +123,9 @@ class IntegerArithmetics(DetectionModule):
         "RETURN",
         "CALL",
     ]
+    # JUMPI/STOP/RETURN/CALL/SSTORE are sinks for already-tainted values;
+    # no issue without an arithmetic source executing
+    trigger_opcodes = ["ADD", "MUL", "EXP", "SUB"]
 
     def __init__(self):
         super().__init__()

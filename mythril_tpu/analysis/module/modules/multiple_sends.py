@@ -40,6 +40,8 @@ class MultipleSends(DetectionModule):
     entry_point = EntryPoint.CALLBACK
     pre_hooks = ["CALL", "DELEGATECALL", "STATICCALL", "CALLCODE",
                  "RETURN", "STOP"]
+    # RETURN/STOP only report calls already recorded on the path
+    trigger_opcodes = ["CALL", "DELEGATECALL", "STATICCALL", "CALLCODE"]
 
     def _analyze_state(self, state):
         annotation = _get_annotation(state)

@@ -44,6 +44,8 @@ class TxOrderDependence(DetectionModule):
     entry_point = EntryPoint.CALLBACK
     pre_hooks = ["CALL"]
     post_hooks = ["BALANCE", "SLOAD"]
+    # BALANCE/SLOAD only source taint; the issue itself fires at a CALL
+    trigger_opcodes = ["CALL"]
 
     def _analyze_state(self, state):
         if not self.is_prehook:

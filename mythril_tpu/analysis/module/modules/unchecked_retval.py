@@ -39,6 +39,8 @@ class UncheckedRetval(DetectionModule):
     entry_point = EntryPoint.CALLBACK
     pre_hooks = ["RETURN", "STOP"]
     post_hooks = ["CALL", "DELEGATECALL", "STATICCALL", "CALLCODE"]
+    # RETURN/STOP only read recorded retvals; no issue without a call
+    trigger_opcodes = ["CALL", "DELEGATECALL", "STATICCALL", "CALLCODE"]
 
     def _analyze_state(self, state):
         annotation = _get_annotation(state)

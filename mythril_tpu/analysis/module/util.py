@@ -9,6 +9,29 @@ from mythril_tpu.analysis.module.loader import ModuleLoader
 from mythril_tpu.support.opcodes import BY_NAME
 
 
+def expand_hook_opcodes(names) -> frozenset:
+    """Expand a hook-name list (exact names + the reference's PREFIX*
+    wildcards, e.g. 'PUSH' -> PUSH1..32) into concrete opcode names."""
+    out = set()
+    for op_name in names:
+        if op_name in BY_NAME:
+            out.add(op_name)
+        else:
+            out.update(n for n in BY_NAME if n.startswith(op_name))
+    return frozenset(out)
+
+
+def module_trigger_opcodes(module: DetectionModule) -> frozenset:
+    """The opcodes that must be executable for `module` to ever raise an
+    issue: its declared trigger_opcodes, defaulting to the union of its
+    hook opcodes (wildcards expanded). Used by the loader's static
+    reachability gate."""
+    triggers = getattr(module, "trigger_opcodes", None)
+    if triggers is None:
+        triggers = list(module.pre_hooks) + list(module.post_hooks)
+    return expand_hook_opcodes(triggers)
+
+
 def get_detection_module_hooks(
     modules: List[DetectionModule], hook_type: str = "pre"
 ) -> Dict[str, List[Callable]]:
@@ -28,12 +51,10 @@ def get_detection_module_hooks(
             continue
         hooks = module.pre_hooks if prehook else module.post_hooks
         for op_name in hooks:
-            if op_name in BY_NAME:
-                hook_dict[op_name].append(bind(module, op_name))
-            else:
-                # wildcard prefix: register on every matching opcode
-                for name in (n for n in BY_NAME if n.startswith(op_name)):
-                    hook_dict[name].append(bind(module, name))
+            # one expansion rule for registration AND the gating trigger
+            # sets (module_trigger_opcodes): the two must never diverge
+            for name in sorted(expand_hook_opcodes([op_name])):
+                hook_dict[name].append(bind(module, name))
     return dict(hook_dict)
 
 

@@ -77,6 +77,29 @@ class SymExecWrapper:
             > 0
         )
 
+        # static bytecode pre-analysis (mythril_tpu/preanalysis/): one CFG
+        # + effect-summary pass per contract before LASER starts. The
+        # summary feeds the engine/strategies as effect hints; the
+        # reachable-opcode set (non-None ONLY when gating is sound:
+        # runtime-mode code, no dynloader, resolved CFG, no CREATE) gates
+        # detection-module attachment below.
+        from mythril_tpu import preanalysis
+
+        self.preanalysis = None
+        gating = None
+        if preanalysis.enabled():
+            try:
+                code_object = (
+                    contract.creation_disassembly
+                    if contract.creation_code is not None
+                    and contract.is_create_mode
+                    else contract.disassembly
+                )
+            except AttributeError:
+                code_object = None
+            self.preanalysis = preanalysis.get_code_summary(code_object)
+            gating = preanalysis.gating_opcodes(contract, dynloader)
+
         self.laser = LaserEVM(
             dynamic_loader=dynloader,
             max_depth=max_depth,
@@ -87,6 +110,7 @@ class SymExecWrapper:
             requires_statespace=requires_statespace,
             beam_width=(getattr(args, "beam_width", None)
                         if strategy == "beam-search" else None),
+            preanalysis=self.preanalysis,
         )
         self.laser.extend_strategy(BoundedLoopsStrategy, loop_bound=loop_bound)
 
@@ -142,7 +166,8 @@ class SymExecWrapper:
 
         if run_analysis_modules:
             analysis_modules = ModuleLoader().get_detection_modules(
-                EntryPoint.CALLBACK, white_list=modules
+                EntryPoint.CALLBACK, white_list=modules,
+                reachable_opcodes=gating,
             )
             self.laser.register_hooks(
                 hook_type="pre",

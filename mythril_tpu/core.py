@@ -210,7 +210,7 @@ class MythrilAnalyzer:
                 "disable_mutation_pruner", "disable_dependency_pruning",
                 "enable_state_merging", "enable_summaries", "solver_backend",
                 "solve_cache", "transaction_sequences", "beam_width",
-                "disable_coverage_strategy", "jobs",
+                "disable_coverage_strategy", "jobs", "no_preanalysis",
             ):
                 if hasattr(cmd_args, field) and getattr(cmd_args, field) is not None:
                     setattr(args, field, getattr(cmd_args, field))

@@ -54,9 +54,21 @@ class Disassembly:
         self.function_entries: Dict[str, int] = _find_function_entries(
             self.instruction_list
         )
+        # reverse index: entry pc -> selector (function_name_for_pc fires
+        # per CFG node during execution, and the preanalysis effect
+        # summaries project per-selector cones through it — a linear scan
+        # per call was O(functions) on the engine's node-creation path).
+        # setdefault keeps the FIRST selector when two selectors share an
+        # entry pc, matching the replaced scan's first-match behavior
+        self.entry_to_selector: Dict[int, str] = {}
+        for selector, pc in self.function_entries.items():
+            self.entry_to_selector.setdefault(pc, selector)
         # parity with reference func_hashes/function_name_to_address fields
         self.func_hashes: List[str] = list(self.function_entries)
         self.bytecode_hash: bytes = keccak256(_concrete_projection(self.bytecode))
+        # preanalysis.get_code_summary memoizes its CodeSummary here (the
+        # code object is immutable); absence of the attribute = not yet
+        # computed, None = computed-and-unavailable (symbolic/empty code)
 
     def __len__(self) -> int:
         return len(self.bytecode)
@@ -72,10 +84,8 @@ class Disassembly:
         return instrs_to_easm(self.instruction_list)
 
     def function_name_for_pc(self, pc: int) -> Optional[str]:
-        for selector, target in self.function_entries.items():
-            if target == pc:
-                return f"_function_0x{selector}"
-        return None
+        selector = self.entry_to_selector.get(pc)
+        return f"_function_0x{selector}" if selector is not None else None
 
 
 def _find_function_entries(instrs: List[Instr]) -> Dict[str, int]:

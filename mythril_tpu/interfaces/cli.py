@@ -188,6 +188,12 @@ def add_analysis_args(parser) -> None:
                              "persistent cross-run store under "
                              "MYTHRIL_TPU_CACHE_DIR; off disables result "
                              "caching (env default: MYTHRIL_TPU_SOLVE_CACHE)")
+    parser.add_argument("--no-preanalysis", action="store_true",
+                        dest="no_preanalysis",
+                        help="disable the static bytecode pre-analysis "
+                             "passes (CFG recovery, detector gating, fork "
+                             "hint pruning, CNF preprocessing); env "
+                             "override: MYTHRIL_TPU_PREANALYSIS=0|1")
     parser.add_argument("--disable-mutation-pruner", action="store_true")
     parser.add_argument("--disable-coverage-strategy", action="store_true")
     parser.add_argument("--disable-dependency-pruning", action="store_true")

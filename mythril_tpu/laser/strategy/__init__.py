@@ -13,6 +13,14 @@ class BasicSearchStrategy:
     def __init__(self, work_list: List[GlobalState], max_depth: int, **kwargs):
         self.work_list = work_list
         self.max_depth = max_depth
+        # static per-function effect hints (a preanalysis.CodeSummary with
+        # `function_effects`: selector -> FunctionEffects), or None when
+        # pre-analysis is disabled/unavailable. Strategies MAY use this to
+        # deprioritize provably effect-free cones; the engine's fork
+        # pruning consumes the same summary to skip feasibility solves
+        # for inert states (svm.exec). Dropping states based on it would
+        # be unsound — hints only reorder or skip redundant solver work.
+        self.effect_hints = kwargs.get("effect_hints")
 
     def __iter__(self):
         return self

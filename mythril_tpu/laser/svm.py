@@ -66,6 +66,7 @@ class LaserEVM:
         iprof=None,
         use_reachability_check: bool = True,
         beam_width: Optional[int] = None,
+        preanalysis=None,
     ):
         self.open_states: List[WorldState] = []
         self.work_list: List[GlobalState] = []
@@ -78,9 +79,19 @@ class LaserEVM:
         self.requires_statespace = requires_statespace
         self.iprof = iprof
 
+        # static pre-analysis summary of the analyzed contract (a
+        # preanalysis.CodeSummary, or None when disabled/unavailable).
+        # Handed to the search strategy as `effect_hints` (per-function
+        # effect summaries) and gates the fork-prune query-skip below —
+        # direct engine users (concolic, vmtests) never set it, so their
+        # behavior is untouched.
+        self.preanalysis = preanalysis
+
         strategy_kwargs = {}
         if beam_width is not None:
             strategy_kwargs["beam_width"] = beam_width
+        if preanalysis is not None:
+            strategy_kwargs["effect_hints"] = preanalysis
         self.strategy = strategy(self.work_list, max_depth, **strategy_kwargs)
 
         # statespace
@@ -302,15 +313,40 @@ class LaserEVM:
                     # --solver-backend=tpu, instead of serial is_possible
                     from mythril_tpu.service.scheduler import get_scheduler
 
+                    # static effect hints (preanalysis): fork sides whose
+                    # remaining cone is provably inert skip the
+                    # feasibility solve and are KEPT unchecked — always
+                    # findings-sound (issues are solver-confirmed; an
+                    # unsat survivor can confirm nothing) and proven
+                    # traffic-free (no detector hooks, no effects in the
+                    # cone; the next open-state reachability gate still
+                    # filters it). Counted as queries_avoided.
+                    check_states = new_states
+                    if self.preanalysis is not None:
+                        from mythril_tpu import preanalysis as pre_mod
+                        from mythril_tpu.smt.solver.statistics import (
+                            SolverStatistics,
+                        )
+
+                        check_states = [
+                            s for s in new_states
+                            if not pre_mod.prune_check_skippable(s)
+                        ]
+                        skipped = len(new_states) - len(check_states)
+                        if skipped:
+                            SolverStatistics().add_queries_avoided(skipped)
                     # engine-path fork pruning: crosscheck off, as above
                     outcomes = get_scheduler().solve_batch(
                         [s.world_state.constraints.get_all_constraints()
-                         for s in new_states],
+                         for s in check_states],
                         crosscheck=False,
                     )
+                    pruned = {
+                        id(s) for s, (status, _model)
+                        in zip(check_states, outcomes) if status == "unsat"
+                    }
                     new_states = [
-                        s for s, (status, _model) in zip(new_states, outcomes)
-                        if status != "unsat"
+                        s for s in new_states if id(s) not in pruned
                     ]
                 elif not self.strategy.run_check():
                     # delayed-solving strategy: forks failing the quick

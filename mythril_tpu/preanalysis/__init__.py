@@ -1,0 +1,191 @@
+"""Static bytecode pre-analysis — compiler-style passes ahead of LASER.
+
+Runs once per code object before symbolic execution starts and feeds
+three consumers (the TVM pattern of analysis/transform passes ahead of
+lowering; every decision is counted in SolverStatistics):
+
+  module gating     the module loader skips attaching DetectionModules
+                    whose trigger opcodes are statically unreachable
+                    (`modules_gated`). Only applied when the executed
+                    code is fully known statically — runtime (non-create)
+                    analysis, no dynamic loader, no CREATE in reach —
+                    and NEVER on CFG-recovery failure: unresolved dynamic
+                    jumps degrade soundly to "everything reachable".
+  fork-prune hints  the engine's stochastic fork pruning skips the
+                    feasibility solve for states whose remaining
+                    transaction cone is provably inert — no state
+                    effects, no detector hook opcodes, no pending
+                    obligations (`queries_avoided`). Keeping a
+                    possibly-unsat state is always findings-sound (every
+                    issue is solver-confirmed); the static proof just
+                    says the kept state cannot generate detector traffic
+                    either.
+  CNF preprocessing unit propagation + pure-literal elimination applied
+                    to every blasted instance before fingerprinting and
+                    router dispatch (smt/solver/frontend._prepare), and
+                    connected-component splitting at the CDCL settle
+                    (`cnf_units_propagated`, `cnf_pure_literals`,
+                    `cnf_clauses_removed`, `cnf_components_split`).
+
+`--no-preanalysis` (CLI) or MYTHRIL_TPU_PREANALYSIS=0 disables the whole
+subsystem; MYTHRIL_TPU_PREANALYSIS=1 force-enables it over the flag.
+"""
+
+import logging
+import os
+from typing import FrozenSet, Optional
+
+from mythril_tpu.preanalysis.effects import (  # noqa: F401 (public API)
+    EFFECT_OPCODES,
+    CodeSummary,
+    FunctionEffects,
+)
+
+log = logging.getLogger(__name__)
+
+
+def enabled() -> bool:
+    """Master switch: env override first, then the --no-preanalysis flag."""
+    env = os.environ.get("MYTHRIL_TPU_PREANALYSIS", "")
+    if env in ("0", "off", "false"):
+        return False
+    if env in ("1", "on", "true"):
+        return True
+    from mythril_tpu.support.args import args
+
+    return not getattr(args, "no_preanalysis", False)
+
+
+# -- per-code summaries (cached on the Disassembly object) -------------------
+
+
+def get_code_summary(disassembly) -> Optional[CodeSummary]:
+    """CodeSummary for a Disassembly, computed once and cached on the
+    object (code objects are immutable). None for empty or symbolic code
+    (deploy-time-patched bytes make the static sweep unreliable)."""
+    if disassembly is None:
+        return None
+    cached = getattr(disassembly, "_preanalysis_summary", _MISS)
+    if cached is not _MISS:
+        return cached
+    summary = None
+    try:
+        if isinstance(disassembly.bytecode, bytes) and disassembly.bytecode:
+            summary = CodeSummary(disassembly)
+    except Exception:
+        # pre-analysis must never break an analysis: degrade to "no info"
+        log.exception("preanalysis failed; continuing without summaries")
+        summary = None
+    try:
+        disassembly._preanalysis_summary = summary
+    except AttributeError:
+        pass
+    return summary
+
+
+# -- consumer 1: module gating -----------------------------------------------
+
+
+def gating_opcodes(contract, dynloader=None) -> Optional[FrozenSet[str]]:
+    """The statically-reachable opcode set usable for detector gating, or
+    None when gating would be unsound / is disabled:
+
+      - pre-analysis disabled
+      - a dynamic loader is configured (other contracts' code can run)
+      - creation-mode analysis (the installed runtime code is a run-time
+        artifact; its opcode set is not statically known)
+      - CFG recovery failed (unresolved dynamic jump: degrade to
+        "everything reachable", gate nothing)
+      - CREATE/CREATE2 reachable (deployed child code is unknowable)
+    """
+    if not enabled() or dynloader is not None:
+        return None
+    try:
+        if contract.is_create_mode or not contract.code_bytes:
+            return None
+        summary = get_code_summary(contract.disassembly)
+    except AttributeError:
+        return None
+    if summary is None or not summary.resolved:
+        return None
+    reachable = summary.reachable_opcodes
+    if reachable & {"CREATE", "CREATE2"}:
+        return None
+    return reachable
+
+
+# -- consumer 2: fork-prune hints --------------------------------------------
+
+
+def _detector_interesting_opcodes() -> FrozenSet[str]:
+    """Opcodes whose reachability makes a cone non-inert: state effects
+    plus every registered detection module's TRIGGER opcodes (the opcodes
+    a module needs executed to ever raise — or solve for — an issue).
+    Computed once per process (the module registry is a singleton).
+
+    Observer hooks (e.g. TxOrigin's JUMPI taint check) are deliberately
+    NOT in this set: a state can ride pre-acquired taint into an
+    observer hook inside an otherwise-inert cone and cost one wasted
+    (UNSAT) confirmation solve — a bounded performance leak, never a
+    finding, since every module's issue path is solver-confirmed and an
+    unsat state confirms nothing."""
+    global _interesting_cache
+    if _interesting_cache is not None:
+        return _interesting_cache
+    from mythril_tpu.analysis.module import EntryPoint, ModuleLoader
+    from mythril_tpu.analysis.module.util import module_trigger_opcodes
+
+    ops = set(EFFECT_OPCODES)
+    for module in ModuleLoader().get_detection_modules():
+        if module.entry_point != EntryPoint.CALLBACK:
+            continue
+        if getattr(module, "symbolic_jump_only", False):
+            # inert_at only holds over cones the CFG fully RESOLVED —
+            # every jump target a push constant, so the engine sees
+            # concrete (never symbolic) destinations and this module's
+            # predicate can never pass inside the cone
+            continue
+        ops |= module_trigger_opcodes(module)
+    _interesting_cache = frozenset(ops)
+    return _interesting_cache
+
+
+_interesting_cache: Optional[FrozenSet[str]] = None
+_MISS = object()
+
+
+def prune_check_skippable(global_state) -> bool:
+    """True iff the stochastic fork-pruning feasibility solve for this
+    state can be skipped (the state is KEPT unchecked — always
+    findings-sound) without generating downstream solver traffic: the
+    state is a top-level frame with no pending issue obligations, and
+    every path from its pc to transaction end within its code object
+    provably avoids state effects and detector hook opcodes."""
+    if not enabled():
+        return False
+    stack = getattr(global_state, "transaction_stack", None)
+    if not stack or len(stack) != 1 or stack[-1][1] is not None:
+        return False  # inner frame: the caller's cone is not covered
+    from mythril_tpu.analysis.issue_annotation import IssueAnnotation
+    from mythril_tpu.analysis.potential_issues import (
+        PotentialIssuesAnnotation,
+    )
+
+    for annotation in global_state.annotations:
+        if isinstance(annotation, PotentialIssuesAnnotation) \
+                and annotation.potential_issues:
+            return False  # pending confirmations would solve at tx end
+        if isinstance(annotation, IssueAnnotation):
+            return False
+    summary = get_code_summary(global_state.environment.code)
+    if summary is None:
+        return False
+    return summary.inert_at(global_state.mstate.pc,
+                            _detector_interesting_opcodes())
+
+
+def reset_caches() -> None:
+    """Testing hook: drop the process-wide interesting-opcode set (module
+    registrations may differ between tests)."""
+    global _interesting_cache
+    _interesting_cache = None

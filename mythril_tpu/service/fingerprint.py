@@ -21,7 +21,13 @@ from typing import Optional
 
 # bump on ANY change to the fingerprint recipe or the blasting pipeline's
 # canonical form — stale entries must miss, never alias
-FINGERPRINT_SCHEMA = 1
+# v2: instances are fingerprinted AFTER static CNF preprocessing
+# (preanalysis/cnf_prep.py) — the same query now hashes its simplified
+# clause form, so v1 entries (keyed by the raw Tseitin form) must miss,
+# never alias. Note this does NOT make differently-spelled but
+# propagation-equal constraint sets share an entry: the AIG roots (hashed
+# below) still reflect the original structure.
+FINGERPRINT_SCHEMA = 2
 
 
 def instance_fingerprint(prep) -> Optional[str]:

@@ -8,9 +8,13 @@ by the instance fingerprint (fingerprint.py). The store is shared across
             so concurrent workers never observe a torn entry
   reads     lock-free (rename is atomic); a hit touches the entry's mtime,
             which is the LRU recency signal
-  eviction  size-capped by entry count (MYTHRIL_TPU_CACHE_MAX_ENTRIES,
-            default 4096): oldest-mtime entries are unlinked under the
-            lock after every write
+  eviction  size-capped two ways, both LRU by mtime and enforced under
+            the lock after every write: by entry count
+            (MYTHRIL_TPU_CACHE_MAX_ENTRIES, default 4096) and by total
+            byte size (MYTHRIL_TPU_CACHE_MAX_BYTES, default unlimited) —
+            oldest entries are unlinked until both caps hold, so a few
+            mega-assignment SAT entries cannot silently blow the disk
+            budget the entry-count cap was sized for
   schema    a VERSION stamp file; a mismatch (new code, old store) wipes
             every entry instead of trusting stale formats
 
@@ -105,7 +109,8 @@ class PersistentResultStore:
     degrade to miss/no-op — the store must never break a solve)."""
 
     def __init__(self, root: Optional[str] = None,
-                 max_entries: Optional[int] = None):
+                 max_entries: Optional[int] = None,
+                 max_bytes: Optional[int] = None):
         self.root = root or _default_root()
         if max_entries is None:
             try:
@@ -115,11 +120,20 @@ class PersistentResultStore:
                 max_entries = 0
         self.max_entries = max_entries if max_entries and max_entries > 0 \
             else DEFAULT_MAX_ENTRIES
-        # approximate local entry count: full directory scans per write
-        # would serialize --jobs workers behind O(entries) stats under the
-        # store lock; the count is re-synced periodically to bound drift
-        # from sibling workers' writes
+        if max_bytes is None:
+            try:
+                max_bytes = int(
+                    os.environ.get("MYTHRIL_TPU_CACHE_MAX_BYTES", ""))
+            except ValueError:
+                max_bytes = 0
+        # 0 = no byte cap (the entry-count cap still applies)
+        self.max_bytes = max_bytes if max_bytes and max_bytes > 0 else 0
+        # approximate local entry count/bytes: full directory scans per
+        # write would serialize --jobs workers behind O(entries) stats
+        # under the store lock; both are re-synced periodically to bound
+        # drift from sibling workers' writes
         self._approx_count: Optional[int] = None
+        self._approx_bytes: Optional[int] = None
         self._writes_since_sync = 0
         self._ok = self._bootstrap()
 
@@ -232,49 +246,87 @@ class PersistentResultStore:
             return False
         try:
             with self._lock():
-                if not atomic_write_json(self._path(fingerprint), payload):
+                path = self._path(fingerprint)
+                # overwrite of an existing fingerprint (e.g. a provenance
+                # upgrade of an UNSAT entry) replaces, not adds: count the
+                # old file out first or the approximations inflate and
+                # trigger spurious O(entries) eviction scans under the lock
+                old_size = None
+                try:
+                    old_size = os.path.getsize(path)
+                except OSError:
+                    pass
+                if not atomic_write_json(path, payload):
                     return False
                 if self._approx_count is None:
                     self._approx_count = self.entry_count()
-                else:
+                elif old_size is None:
                     self._approx_count += 1
+                if self.max_bytes:
+                    if self._approx_bytes is None:
+                        self._approx_bytes = self.total_bytes()
+                    else:
+                        try:
+                            self._approx_bytes += (
+                                os.path.getsize(path) - (old_size or 0))
+                        except OSError:
+                            pass
                 self._writes_since_sync += 1
                 if self._writes_since_sync >= self._COUNT_SYNC_INTERVAL:
                     # re-sync against sibling workers' writes
                     self._approx_count = self.entry_count()
+                    if self.max_bytes:
+                        self._approx_bytes = self.total_bytes()
                     self._writes_since_sync = 0
-                if self._approx_count > self.max_entries:
-                    self._evict_locked()
-                    self._approx_count = self.entry_count()
+                if self._approx_count > self.max_entries or (
+                        self.max_bytes
+                        and (self._approx_bytes or 0) > self.max_bytes):
+                    # eviction walks the directory once and returns the
+                    # exact post-eviction figures — re-scanning here would
+                    # triple the O(entries) stat sweeps under the lock
+                    self._approx_count, self._approx_bytes = \
+                        self._evict_locked()
             return True
         except OSError:
             return False
 
-    def _evict_locked(self) -> None:
-        """LRU eviction by mtime; caller holds the store lock."""
+    def _evict_locked(self):
+        """LRU eviction by mtime until BOTH caps hold (entry count, and —
+        when configured — total bytes); caller holds the store lock. The
+        most recent entry is never evicted: a byte cap smaller than one
+        entry is a misconfiguration, and deleting the entry that was just
+        written would make every write a no-op. Returns the exact
+        post-eviction (entry count, total bytes) so the caller can refresh
+        its approximations without another directory sweep."""
         try:
-            entries = [
-                name for name in os.listdir(self.root)
-                if name.endswith(".json")
-            ]
-            overflow = len(entries) - self.max_entries
-            if overflow <= 0:
-                return
-            stamped = []
-            for name in entries:
+            stamped = []  # (mtime, size, path), oldest first
+            total_size = 0
+            for name in os.listdir(self.root):
+                if not name.endswith(".json"):
+                    continue
                 path = os.path.join(self.root, name)
                 try:
-                    stamped.append((os.path.getmtime(path), path))
+                    stat = os.stat(path)
                 except OSError:
-                    pass
+                    continue
+                stamped.append((stat.st_mtime, stat.st_size, path))
+                total_size += stat.st_size
             stamped.sort()
-            for _mtime, path in stamped[:overflow]:
+            count = len(stamped)
+            for _mtime, size, path in stamped[:-1]:
+                over_count = count > self.max_entries
+                over_bytes = self.max_bytes and total_size > self.max_bytes
+                if not over_count and not over_bytes:
+                    break
                 try:
                     os.unlink(path)
                 except OSError:
-                    pass
+                    continue
+                count -= 1
+                total_size -= size
+            return count, total_size
         except OSError:
-            pass
+            return self._approx_count, self._approx_bytes
 
     def entry_count(self) -> int:
         if not self._ok:
@@ -284,6 +336,24 @@ class PersistentResultStore:
                        if name.endswith(".json"))
         except OSError:
             return 0
+
+    def total_bytes(self) -> int:
+        """Sum of entry file sizes (the quantity MYTHRIL_TPU_CACHE_MAX_BYTES
+        caps)."""
+        if not self._ok:
+            return 0
+        total = 0
+        try:
+            for name in os.listdir(self.root):
+                if not name.endswith(".json"):
+                    continue
+                try:
+                    total += os.path.getsize(os.path.join(self.root, name))
+                except OSError:
+                    pass
+        except OSError:
+            return 0
+        return total
 
 
 _store: Optional[PersistentResultStore] = None

@@ -487,11 +487,58 @@ class Solver:
             for (name, sort) in terms.free_symbols(
                 list(lowered) + list(lowered_objectives))
         }
+        # static CNF preprocessing (preanalysis/cnf_prep.py): unit
+        # propagation + pure literals over the blasted instance BEFORE the
+        # disk-tier fingerprint and router dispatch see it — variable
+        # numbering is preserved, so dense maps, sessions, stored-bit
+        # replay, and reconstruction are untouched. The pure-literal rule
+        # is withheld when objectives exist: Optimize probes the instance
+        # under assumptions later, and pinning a bit the original CNF
+        # leaves free would flip those probes' verdicts (mis-minimizing
+        # exploits). A propagation-derived CONFLICT deliberately does NOT
+        # short-circuit: the detection path's UNSAT verdicts carry a
+        # permuted-instance second opinion (sat_backend._crosscheck_unsat),
+        # and a preprocessor-trusted UNSAT would silently bypass that
+        # soundness net — the original clauses go to the CDCL, which
+        # re-derives the conflict by native propagation in microseconds
+        # and applies the standard crosscheck policy.
+        from mythril_tpu import preanalysis
+
+        if preanalysis.enabled():
+            from mythril_tpu.preanalysis.cnf_prep import preprocess_cnf
+            from mythril_tpu.support.args import args as _args
+
+            # the pure rule is also withheld when this instance may ride
+            # the device path: the circuit kernel searches the ORIGINAL
+            # AIG's model space, and a model putting a pure-pinned
+            # variable at the opposite polarity would fail the clause
+            # check against the pinned CNF — a wasted device hit
+            device_possible = (
+                _args.solver_backend == "tpu" and self.allow_device)
+            simplified = preprocess_cnf(
+                prep.num_vars, prep.clauses,
+                allow_pure=not objectives and not device_possible)
+            if simplified is not None and simplified.changed \
+                    and not simplified.conflict:
+                SolverStatistics().add_cnf_preprocess(
+                    simplified.units, simplified.pures,
+                    simplified.removed_clauses)
+                prep.clauses = simplified.cnf
         return prep
 
     def _solve_prepared(self, prep: "_Prepared",
                         assumptions: List[int] = ()) -> str:
         aig_roots = prep.aig_roots if not assumptions else None
+        # connected-component splitting (preanalysis/cnf_prep.py): when
+        # this solve is host-CDCL-bound anyway, variable-disjoint
+        # sub-instances settle independently (first UNSAT component ends
+        # it; SAT components' models recompose through _reconstruct).
+        # Assumption probes reuse the monolithic session instead — their
+        # literals may bridge components across probes.
+        if not assumptions and prep.session is None:
+            split_status = self._try_solve_split(prep)
+            if split_status is not None:
+                return split_status
         # per-query session: the instance loads into a persistent native
         # solver on first use; every later probe (Optimize bit fixing,
         # re-solves) reuses it under assumptions with learnt clauses intact
@@ -517,6 +564,62 @@ class Solver:
             prep.last_bits = bits
             self._model = self._reconstruct(prep, bits)
         return status
+
+    def _try_solve_split(self, prep: "_Prepared") -> Optional[str]:
+        """Settle a multi-component instance component-by-component on the
+        host CDCL; None when splitting does not apply (single component,
+        oversize, preanalysis off, or a device dispatch is still possible
+        for the whole cone — the circuit kernel needs the full AIG)."""
+        from mythril_tpu import preanalysis
+        from mythril_tpu.support.args import args as _args
+
+        if not preanalysis.enabled():
+            return None
+        if (_args.solver_backend == "tpu" and self.allow_device
+                and prep.aig_roots is not None):
+            return None
+        from mythril_tpu.preanalysis.cnf_prep import (
+            merge_component_bits,
+            split_components,
+        )
+
+        components = split_components(prep.num_vars, prep.clauses)
+        if components is None:
+            return None
+        SolverStatistics().add_cnf_split(len(components))
+        deadline = (time.monotonic() + self.timeout) if self.timeout else None
+        bits_list = []
+        for component in components:
+            if component.trivial_bits is not None:
+                # all-unit consistent component: its model is its literals
+                # (no solver round-trip, no cdcl_settle counted)
+                bits_list.append(component.trivial_bits)
+                continue
+            remaining = 0.0
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return UNKNOWN
+            status, bits = sat_backend.solve_cnf(
+                component.num_vars,
+                component.cnf,
+                timeout_seconds=max(remaining, 0.0),
+                conflict_budget=self.conflict_budget,
+                allow_device=False,
+                # an UNSAT component proves the whole instance UNSAT: it
+                # carries the detection-path second opinion (and its
+                # crosscheck-confirmed flag feeds persistence provenance)
+                crosscheck=self.unsat_crosscheck,
+            )
+            if status == UNSAT:
+                return UNSAT
+            if status != SAT:
+                return UNKNOWN
+            bits_list.append(bits)
+        merged = merge_component_bits(prep.num_vars, components, bits_list)
+        prep.last_bits = merged
+        self._model = self._reconstruct(prep, merged)
+        return SAT
 
     def _check(self, extra: List[Term]) -> str:
         self._model = None

@@ -38,6 +38,16 @@ class SolverStatistics:
         # real host-CDCL solver invocations (counted at the sat_backend
         # terminal solve — the number every cache tier exists to shrink)
         "cdcl_settles",
+        # static pre-analysis (mythril_tpu/preanalysis/): solver traffic
+        # proven unnecessary before any solve — the SOLAR-style
+        # "speed-of-light" denominator
+        "modules_gated",
+        "queries_avoided",
+        "cnf_units_propagated",
+        "cnf_pure_literals",
+        "cnf_clauses_removed",
+        "cnf_components_split",
+        "router_dispatched_clauses",
     )
     _TIMERS = (
         "solver_time",
@@ -176,6 +186,41 @@ class SolverStatistics:
         if self.enabled:
             self.cdcl_settles += 1
 
+    def add_module_gated(self, count: int = 1) -> None:
+        """A detection module the static reachability gate skipped
+        attaching — its hooks, predicate solves, and confirmations never
+        happen this run (preanalysis module gating)."""
+        if self.enabled:
+            self.modules_gated += count
+
+    def add_queries_avoided(self, count: int = 1) -> None:
+        """Fork-pruning feasibility solves skipped because static
+        pre-analysis proved the state's remaining cone inert — queries
+        the engine would otherwise have paid for."""
+        if self.enabled:
+            self.queries_avoided += count
+
+    def add_cnf_preprocess(self, units: int, pures: int,
+                           removed_clauses: int) -> None:
+        """One blasted instance simplified by the static CNF preprocessor
+        before fingerprinting/dispatch (preanalysis/cnf_prep.py)."""
+        if self.enabled:
+            self.cnf_units_propagated += units
+            self.cnf_pure_literals += pures
+            self.cnf_clauses_removed += removed_clauses
+
+    def add_cnf_split(self, components: int) -> None:
+        """One instance the CDCL settled as `components` variable-disjoint
+        sub-instances instead of a single monolithic solve."""
+        if self.enabled:
+            self.cnf_components_split += components
+
+    def add_router_clauses(self, clauses: int) -> None:
+        """CNF clause volume of queries reaching the device router —
+        preprocessed shrinkage shows up here as smaller dispatched cones."""
+        if self.enabled:
+            self.router_dispatched_clauses += clauses
+
     @property
     def coalesce_occupancy(self) -> float:
         """Mean queries per coalescing-window flush (>1 means single-query
@@ -251,6 +296,15 @@ class SolverStatistics:
                     f" occupancy {self.coalesce_occupancy:.2f})")
         if self.cdcl_settles:
             out += f", cdcl settles: {self.cdcl_settles}"
+        if self.modules_gated or self.queries_avoided \
+                or self.cnf_units_propagated or self.cnf_pure_literals \
+                or self.cnf_components_split:
+            out += (f", preanalysis: {self.modules_gated} modules gated"
+                    f"/{self.queries_avoided} queries avoided"
+                    f"/{self.cnf_units_propagated} units"
+                    f"+{self.cnf_pure_literals} pures propagated"
+                    f" ({self.cnf_clauses_removed} clauses removed,"
+                    f" {self.cnf_components_split} components split)")
         if self.crosscheck_runs or self.crosscheck_cap_skips:
             out += (f", unsat crosschecks: {self.crosscheck_runs}"
                     f" (+{self.crosscheck_cap_skips} cap-skipped)")

@@ -422,6 +422,11 @@ class QueryRouter:
             num_vars, clauses, aig_roots = problem
             if num_vars == 0 or aig_roots is None:
                 continue
+            if stats is not None:
+                # clause volume reaching the router: the static CNF
+                # preprocessor's shrinkage is visible here as smaller
+                # dispatched cones (bench compares preanalysis on/off)
+                stats.add_router_clauses(len(clauses))
             pc = self.backend.pack_problem(problem, v1_cap)
             if pc is None:  # pre-pack var-cap reject (counted by backend)
                 continue

@@ -1,0 +1,382 @@
+"""Static pre-analysis tests: CFG recovery, effect summaries, detector
+gating soundness (identical findings with preanalysis on vs off), and the
+degradation contract — an unresolvable dynamic jump must gate ZERO
+modules."""
+
+import json
+
+import pytest
+
+from mythril_tpu.disasm.asm import easm_to_code
+from mythril_tpu.disasm.disassembly import Disassembly
+from mythril_tpu.ethereum.evmcontract import EVMContract
+from mythril_tpu import preanalysis
+from mythril_tpu.analysis.module import EntryPoint, ModuleLoader
+from mythril_tpu.core import MythrilAnalyzer, MythrilDisassembler
+from mythril_tpu.smt.solver.statistics import SolverStatistics
+from mythril_tpu.support.args import args
+from tests.test_analysis import KILLBILLY, wrap_creation
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    args.reset()
+    preanalysis.reset_caches()
+    from mythril_tpu.support.model import clear_caches
+
+    clear_caches()
+    yield
+    args.reset()
+    preanalysis.reset_caches()
+
+
+def _stats():
+    stats = SolverStatistics()
+    stats.reset()
+    stats.enabled = True
+    return stats
+
+
+# -- CFG recovery ------------------------------------------------------------
+
+
+def test_cfg_resolves_dispatcher_and_push_jumps():
+    summary = preanalysis.get_code_summary(Disassembly(KILLBILLY))
+    assert summary is not None
+    assert summary.resolved
+    assert "SELFDESTRUCT" in summary.reachable_opcodes
+    assert "CALL" not in summary.reachable_opcodes
+    # selector map projected to effect summaries
+    assert "41c0e1b5" in summary.function_effects
+    effects = summary.function_effects["41c0e1b5"]
+    assert effects.bounded
+    assert effects.effects == {"SELFDESTRUCT"}
+
+
+def test_cfg_resolves_pushed_return_address():
+    """solc-style internal call: the return address is pushed by the
+    caller and consumed by a JUMP at the callee's end — resolved via the
+    abstract-stack dataflow, not a peephole."""
+    code = easm_to_code("""
+        PUSH1 @ret
+        PUSH1 @fn
+        JUMP
+    :fn
+        JUMPDEST
+        CALLER
+        POP
+        JUMP
+    :ret
+        JUMPDEST
+        STOP
+    """)
+    summary = preanalysis.get_code_summary(Disassembly(code))
+    assert summary.resolved
+    assert "STOP" in summary.reachable_opcodes
+
+
+def test_cfg_unresolved_dynamic_jump_degrades_to_linear():
+    code = easm_to_code("""
+        PUSH1 0x00
+        CALLDATALOAD
+        JUMP
+    :a
+        JUMPDEST
+        SELFDESTRUCT
+    """)
+    summary = preanalysis.get_code_summary(Disassembly(code))
+    assert not summary.resolved
+    # degradation: everything in the code counts as reachable
+    assert summary.reachable_opcodes == summary.linear_opcodes
+    assert "SELFDESTRUCT" in summary.reachable_opcodes
+    # and no cone can be bounded through the dynamic jump
+    assert summary.cone_opcodes(0) is None
+
+
+def test_cone_unbounded_for_blocks_the_dataflow_never_visited():
+    """A block enterable only through an unresolvable dynamic jump keeps
+    its constructor-default (empty) successor list — trusting that would
+    declare its cone bounded/inert while the real continuation executes
+    effectful code. cone_opcodes must refuse to bound it."""
+    code = easm_to_code("""
+        PUSH1 0x00
+        CALLDATALOAD
+        JUMP
+    :hidden
+        JUMPDEST
+        PUSH1 @effectful
+        JUMP
+    :effectful
+        JUMPDEST
+        CALLER
+        SELFDESTRUCT
+    """)
+    summary = preanalysis.get_code_summary(Disassembly(code))
+    assert not summary.resolved
+    hidden_pc = next(
+        i.address for i in Disassembly(code).instruction_list
+        if i.opcode == "JUMPDEST")
+    assert summary.cone_opcodes(hidden_pc) is None
+    assert not summary.inert_at(hidden_pc, frozenset({"SELFDESTRUCT"}))
+
+
+def test_duplicate_entry_pcs_keep_first_selector():
+    """Two selectors dispatching to one JUMPDEST: the reverse index must
+    preserve the original first-match naming, not last-iterated."""
+    disassembly = Disassembly(KILLBILLY)
+    disassembly.function_entries["ffffffff"] = (
+        disassembly.function_entries["41c0e1b5"])
+    rebuilt = {}
+    for selector, pc in disassembly.function_entries.items():
+        rebuilt.setdefault(pc, selector)
+    assert rebuilt[disassembly.function_entries["41c0e1b5"]] == "41c0e1b5"
+    # the shipped index was built the same way at construction time
+    assert disassembly.function_name_for_pc(
+        disassembly.function_entries["41c0e1b5"]) == "_function_0x41c0e1b5"
+
+
+def test_statically_dead_block_is_unreachable():
+    """A block no resolved jump targets and no fall-through reaches is
+    excluded from the reachable set (the refinement gating relies on)."""
+    code = easm_to_code("""
+        PUSH1 @live
+        JUMP
+    :dead
+        JUMPDEST
+        ORIGIN
+        POP
+        STOP
+    :live
+        JUMPDEST
+        STOP
+    """)
+    # :dead IS fall-through-reachable from the entry block? No: the entry
+    # block ends in JUMP (no fall-through), so :dead is dead.
+    summary = preanalysis.get_code_summary(Disassembly(code))
+    assert summary.resolved
+    assert "ORIGIN" in summary.linear_opcodes
+    assert "ORIGIN" not in summary.reachable_opcodes
+
+
+# -- gating ------------------------------------------------------------------
+
+
+def _gated_count(reachable):
+    stats = _stats()
+    before = stats.modules_gated
+    attached = ModuleLoader().get_detection_modules(
+        EntryPoint.CALLBACK, reachable_opcodes=reachable)
+    return stats.modules_gated - before, attached
+
+
+def test_gating_skips_unreachable_trigger_modules():
+    contract = EVMContract(code=KILLBILLY.hex())
+    reachable = preanalysis.gating_opcodes(contract)
+    assert reachable is not None
+    gated, attached = _gated_count(reachable)
+    names = {m.name for m in attached}
+    assert gated > 0
+    # SELFDESTRUCT is reachable: the suicide module must stay attached
+    assert "unprotected_selfdestruct" in names or "suicide" in {
+        type(m).__name__.lower() for m in attached
+    } or any("kill" in n or "suicide" in n for n in names)
+    # no CALL/DELEGATECALL/ORIGIN anywhere: those modules must be gated
+    assert "arbitrary_delegatecall" not in names
+    assert "tx_origin" not in names
+    assert "external_calls" not in names
+
+
+def test_unresolvable_dynamic_jump_gates_zero_modules():
+    """The ISSUE's degradation contract: CFG-recovery failure means
+    "everything reachable" — gating_opcodes returns None and the loader
+    gates nothing."""
+    runtime = easm_to_code("""
+        PUSH1 0x00
+        CALLDATALOAD
+        JUMP
+    :a
+        JUMPDEST
+        STOP
+    """)
+    contract = EVMContract(code=runtime.hex())
+    assert preanalysis.gating_opcodes(contract) is None
+    gated, attached = _gated_count(None)
+    assert gated == 0
+    assert len(attached) == len(
+        ModuleLoader().get_detection_modules(EntryPoint.CALLBACK))
+
+
+def test_creation_mode_contract_never_gates():
+    """The installed runtime code is a run-time artifact in creation-mode
+    analysis; gating would be guessing."""
+    contract = EVMContract(creation_code=wrap_creation(KILLBILLY))
+    assert contract.is_create_mode
+    assert preanalysis.gating_opcodes(contract) is None
+
+
+def test_dynloader_disables_gating():
+    contract = EVMContract(code=KILLBILLY.hex())
+    assert preanalysis.gating_opcodes(contract, dynloader=object()) is None
+
+
+def test_reachable_create_disables_gating():
+    code = easm_to_code("""
+        PUSH1 0x00
+        PUSH1 0x00
+        PUSH1 0x00
+        CREATE
+        POP
+        STOP
+    """)
+    contract = EVMContract(code=code.hex())
+    assert preanalysis.gating_opcodes(contract) is None
+
+
+def test_no_preanalysis_flag_disables_everything():
+    args.no_preanalysis = True
+    assert not preanalysis.enabled()
+    contract = EVMContract(code=KILLBILLY.hex())
+    assert preanalysis.gating_opcodes(contract) is None
+
+
+def test_env_force_enable_overrides_flag(monkeypatch):
+    args.no_preanalysis = True
+    monkeypatch.setenv("MYTHRIL_TPU_PREANALYSIS", "1")
+    assert preanalysis.enabled()
+    monkeypatch.setenv("MYTHRIL_TPU_PREANALYSIS", "0")
+    args.no_preanalysis = False
+    assert not preanalysis.enabled()
+
+
+# -- findings parity (gating soundness end to end) ---------------------------
+
+
+class _Args:
+    execution_timeout = 60
+    transaction_count = 2
+    max_depth = 128
+    pruning_factor = 1.0  # exercise the fork-prune hint path
+
+
+def _analyze_json(code_hex: str, bin_runtime: bool, tx_count: int) -> str:
+    from mythril_tpu.support.model import clear_caches
+
+    clear_caches()
+    preanalysis.reset_caches()
+    disassembler = MythrilDisassembler()
+    disassembler.load_from_bytecode(code_hex, bin_runtime=bin_runtime)
+    analyzer = MythrilAnalyzer(disassembler, cmd_args=_Args(),
+                               strategy="bfs")
+    report = analyzer.fire_lasers(transaction_count=tx_count)
+    return report.as_json()
+
+
+# a small local golden corpus: creation-mode, runtime-mode (gating
+# active), and a storage-writing contract with a guarded branch
+STORE_GUARDED = easm_to_code("""
+    PUSH1 0x00
+    CALLDATALOAD
+    PUSH1 0xe0
+    SHR
+    DUP1
+    PUSH4 0x11223344
+    EQ
+    PUSH1 @setter
+    JUMPI
+    STOP
+:setter
+    JUMPDEST
+    PUSH1 0x04
+    CALLDATALOAD
+    PUSH1 0x00
+    SSTORE
+    STOP
+""")
+
+PARITY_CASES = [
+    ("killbilly-runtime", KILLBILLY.hex(), True, 1),
+    ("killbilly-creation", wrap_creation(KILLBILLY), False, 1),
+    ("store-guarded-runtime", STORE_GUARDED.hex(), True, 2),
+]
+
+
+@pytest.mark.parametrize("name,code_hex,bin_runtime,tx_count",
+                         PARITY_CASES, ids=[c[0] for c in PARITY_CASES])
+def test_findings_parity_preanalysis_on_vs_off(name, code_hex, bin_runtime,
+                                               tx_count):
+    """Gating/hints/CNF preprocessing must be invisible in the findings:
+    byte-identical report JSON with preanalysis on vs off."""
+    stats = _stats()
+    args.no_preanalysis = False
+    on_report = _analyze_json(code_hex, bin_runtime, tx_count)
+    on_counters = (stats.modules_gated, stats.queries_avoided,
+                   stats.cnf_units_propagated)
+    args.no_preanalysis = True
+    off_report = _analyze_json(code_hex, bin_runtime, tx_count)
+    assert json.loads(on_report)["issues"] == json.loads(off_report)["issues"]
+    if bin_runtime and name == "killbilly-runtime":
+        assert on_counters[0] > 0, "gating should fire on runtime killbilly"
+        assert on_counters[2] > 0, "CNF preprocessing should fire"
+
+
+def test_queries_avoided_counts_inert_fork_skips():
+    """The dispatcher fall-through of killbilly ends in a bare STOP — an
+    inert cone whose fork-side feasibility solve the hint path skips."""
+    stats = _stats()
+    args.no_preanalysis = False
+    _analyze_json(KILLBILLY.hex(), True, 1)
+    assert stats.queries_avoided >= 1
+
+
+REFERENCE_INPUTS = "/root/reference/tests/testdata/inputs"
+
+
+@pytest.mark.skipif(not __import__("os").path.isdir(REFERENCE_INPUTS),
+                    reason="reference testdata not mounted")
+@pytest.mark.parametrize("file_name,tx_count,bin_runtime", [
+    ("suicide.sol.o", 1, False),
+    ("origin.sol.o", 1, False),
+    ("ether_send.sol.o", 2, True),
+], ids=["suicide", "origin", "ether_send"])
+def test_reference_corpus_parity_on_vs_off(file_name, tx_count, bin_runtime):
+    """Golden-corpus gating soundness: full analyze subprocess with
+    preanalysis on vs off must produce byte-identical issue JSON."""
+    import os
+    import subprocess
+    import sys
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    outputs = []
+    for flags in ((), ("--no-preanalysis",)):
+        cmd = [sys.executable, "-m", "mythril_tpu", "analyze",
+               "-f", os.path.join(REFERENCE_INPUTS, file_name),
+               "-t", str(tx_count), "-o", "json",
+               "--solver-timeout", "60000"] + list(flags)
+        if bin_runtime:
+            cmd.append("--bin-runtime")
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=600, cwd=repo_root, env=env)
+        assert proc.stdout.strip(), proc.stderr[-2000:]
+        outputs.append(
+            json.loads(proc.stdout.strip().splitlines()[-1])["issues"])
+    assert outputs[0] == outputs[1]
+
+
+def test_effect_hints_reach_the_strategy():
+    """The summary handed to LaserEVM rides the strategy chain as
+    effect_hints (per-function effect summaries for prioritization)."""
+    from mythril_tpu.analysis.symbolic import SymExecWrapper
+
+    contract = EVMContract(code=KILLBILLY.hex())
+    sym = SymExecWrapper(
+        contract, 0xAFFE, "bfs", max_depth=32, execution_timeout=5,
+        transaction_count=1, compulsory_statespace=False,
+    )
+    assert sym.preanalysis is not None
+    base = sym.laser.strategy
+    while hasattr(base, "super_strategy"):
+        base = base.super_strategy
+    assert base.effect_hints is sym.preanalysis
+    assert "41c0e1b5" in sym.preanalysis.function_effects

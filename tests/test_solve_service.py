@@ -285,6 +285,34 @@ def test_store_lru_eviction_caps_entries(tmp_path):
     assert store.lookup(f"{0:064x}") is None
 
 
+def test_store_byte_size_eviction_oldest_first(tmp_path):
+    """MYTHRIL_TPU_CACHE_MAX_BYTES: entries past the byte budget evict
+    oldest-mtime-first even when the entry-count cap is nowhere near."""
+    import os
+    import time
+
+    store = PersistentResultStore(root=str(tmp_path / "bytes"),
+                                  max_entries=1000, max_bytes=1)
+    # oversized entries (every entry > 1 byte): each write must evict the
+    # previous (older) entry, keeping only the newest
+    for i in range(4):
+        assert store.store_sat(f"{i:064x}", num_vars=64, bits=[True] * 65)
+        time.sleep(0.02)  # distinct mtimes for the LRU order
+    assert store.lookup(f"{3:064x}") is not None  # newest survives
+    for i in range(3):
+        assert store.lookup(f"{i:064x}") is None  # oldest evicted first
+
+
+def test_store_byte_cap_env_and_accounting(tmp_path, monkeypatch):
+    monkeypatch.setenv("MYTHRIL_TPU_CACHE_MAX_BYTES", "100000")
+    store = PersistentResultStore(root=str(tmp_path / "bytesenv"))
+    assert store.max_bytes == 100000
+    assert store.store_unsat("a" * 64, crosschecked=False)
+    assert store.total_bytes() > 0
+    # under budget: nothing evicted
+    assert store.entry_count() == 1
+
+
 def test_clear_caches_resets_service_handles():
     args.solve_cache = "disk"
     first = get_result_store()

@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Repo lint: every MYTHRIL_TPU_* environment variable mentioned anywhere
+in mythril_tpu/ must be documented in README.md's env-var table.
+
+The scan is deliberately textual (any occurrence of the token counts, in
+code or docstrings): an env read hidden behind string concatenation would
+dodge an AST-based scan, and a variable worth naming in a docstring is
+worth a README row anyway. Exits 1 listing the undocumented variables;
+also reports (as a warning, not a failure) documented variables no longer
+mentioned in the tree — usually a retired knob whose row should be
+dropped. Wired into tier-1 via tests/test_env_docs.py.
+
+Usage: python tools/check_env_docs.py [repo_root]
+"""
+
+import os
+import re
+import sys
+
+ENV_TOKEN = re.compile(r"MYTHRIL_TPU_[A-Z0-9_]+")
+# README table rows look like: | `MYTHRIL_TPU_FOO` | meaning |
+README_ROW = re.compile(r"^\|\s*`(MYTHRIL_TPU_[A-Z0-9_]+)`\s*\|")
+
+
+def used_env_vars(package_dir: str) -> set:
+    used = set()
+    for dirpath, _dirnames, filenames in os.walk(package_dir):
+        for name in filenames:
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            try:
+                with open(path, encoding="utf-8") as handle:
+                    used.update(ENV_TOKEN.findall(handle.read()))
+            except OSError:
+                continue
+    return used
+
+
+def documented_env_vars(readme_path: str) -> set:
+    documented = set()
+    try:
+        with open(readme_path, encoding="utf-8") as handle:
+            for line in handle:
+                match = README_ROW.match(line.strip())
+                if match:
+                    documented.add(match.group(1))
+    except OSError:
+        pass
+    return documented
+
+
+def main(argv) -> int:
+    root = os.path.abspath(
+        argv[1] if len(argv) > 1
+        else os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+    )
+    package_dir = os.path.join(root, "mythril_tpu")
+    readme = os.path.join(root, "README.md")
+    if not os.path.isdir(package_dir):
+        print(f"error: {package_dir} is not a directory", file=sys.stderr)
+        return 2
+    used = used_env_vars(package_dir)
+    documented = documented_env_vars(readme)
+    missing = sorted(used - documented)
+    stale = sorted(documented - used)
+    if stale:
+        print("warning: documented in README but not mentioned under "
+              "mythril_tpu/: " + ", ".join(stale), file=sys.stderr)
+    if missing:
+        print("FAIL: environment variables read under mythril_tpu/ but "
+              "missing from README.md's env-var table:", file=sys.stderr)
+        for name in missing:
+            print(f"  {name}", file=sys.stderr)
+        return 1
+    print(f"ok: {len(used)} MYTHRIL_TPU_* variables, all documented")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
