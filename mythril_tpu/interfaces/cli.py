@@ -194,6 +194,13 @@ def add_analysis_args(parser) -> None:
                              "passes (CFG recovery, detector gating, fork "
                              "hint pruning, CNF preprocessing); env "
                              "override: MYTHRIL_TPU_PREANALYSIS=0|1")
+    parser.add_argument("--no-aig-opt", action="store_true",
+                        dest="no_aig_opt",
+                        help="disable the AIG structural optimization "
+                             "passes over blasted solver instances "
+                             "(strashing, constant sweeping, per-component "
+                             "root projection); env override: "
+                             "MYTHRIL_TPU_AIG_OPT=0|1")
     parser.add_argument("--disable-mutation-pruner", action="store_true")
     parser.add_argument("--disable-coverage-strategy", action="store_true")
     parser.add_argument("--disable-dependency-pruning", action="store_true")

@@ -219,21 +219,15 @@ def split_components(num_vars: int, clauses) -> Optional[List[Component]]:
     var = np.abs(lits)
     if var.max(initial=0) > num_vars:
         return None
-    try:
-        import scipy.sparse as sparse
-        from scipy.sparse.csgraph import connected_components
-    except ImportError:
-        return None  # no native connectivity pass: splitting not worth it
+    from mythril_tpu.preanalysis.components import connected_labels
 
     lengths = offsets[1:] - offsets[:-1]
     clause_ids = np.repeat(np.arange(n_clauses, dtype=np.int64), lengths)
     # bipartite incidence: var nodes [0..num_vars], clause nodes after
-    nodes = num_vars + 1 + n_clauses
-    graph = sparse.coo_matrix(
-        (np.ones(len(var), dtype=np.int8),
-         (var, clause_ids + num_vars + 1)),
-        shape=(nodes, nodes))
-    _count, labels = connected_components(graph, directed=False)
+    labels = connected_labels(
+        num_vars + 1 + n_clauses, var, clause_ids + num_vars + 1)
+    if labels is None:
+        return None
     clause_label = labels[var[offsets[:-1]]]
     distinct = np.unique(clause_label)
     if len(distinct) < 2:

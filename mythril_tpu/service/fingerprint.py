@@ -13,6 +13,12 @@ clause order is kept as emitted (deterministic for a given cone). A
 fingerprint collision can never alias a verdict — SAT entries are
 replay-verified against the ORIGINAL constraints on every hit
 (support/model._probe_persistent) and a failed replay is a safe miss.
+
+Partitioned instances (preanalysis/aig_partition.py) additionally
+fingerprint each variable-disjoint component as its OWN sub-instance
+(component_fingerprint): a sub-cone shared by different parent queries
+hashes identically in both, so the disk tier hits across parents even
+when the monolithic fingerprints differ.
 """
 
 import hashlib
@@ -24,21 +30,18 @@ from typing import Optional
 # v2: instances are fingerprinted AFTER static CNF preprocessing
 # (preanalysis/cnf_prep.py) — the same query now hashes its simplified
 # clause form, so v1 entries (keyed by the raw Tseitin form) must miss,
-# never alias. Note this does NOT make differently-spelled but
-# propagation-equal constraint sets share an entry: the AIG roots (hashed
-# below) still reflect the original structure.
-FINGERPRINT_SCHEMA = 2
+# never alias.
+# v3: instances are fingerprinted AFTER the AIG structural rewrite
+# (preanalysis/aig_opt.py): the canonical form is now the swept/strashed
+# cone's dense CNF + rewritten roots, so v2 entries (keyed by the raw
+# blasted form) must miss, never alias. Per-component sub-instance
+# fingerprints share this version stamp (they flow into the same store).
+FINGERPRINT_SCHEMA = 3
 
 
-def instance_fingerprint(prep) -> Optional[str]:
-    """sha256 hex digest of `prep`'s blasted instance in canonical form,
-    or None when the instance has no blasted CNF (trivial verdicts)."""
-    clauses = getattr(prep, "clauses", None)
-    if clauses is None or getattr(prep, "blaster", None) is None:
-        return None
-    digest = hashlib.sha256()
-    digest.update(b"mythril-tpu-solve-v%d:" % FINGERPRINT_SCHEMA)
-    digest.update(struct.pack("<q", prep.num_vars))
+def _digest_cnf(digest, num_vars: int, clauses) -> None:
+    """Feed (num_vars, canonicalized clauses) into `digest`."""
+    digest.update(struct.pack("<q", num_vars))
     if hasattr(clauses, "lits"):
         import numpy as np
 
@@ -58,11 +61,38 @@ def instance_fingerprint(prep) -> Optional[str]:
             for lit in sorted(clause):
                 digest.update(struct.pack("<i", lit))
             digest.update(b";")
-    # AIG roots, mapped global var -> dense var (the cone's canonical
-    # numbering); constant/outside-cone roots hash as 0
+
+
+def _digest_roots(digest, roots, dense) -> None:
+    """AIG roots, mapped global var -> dense var (the cone's canonical
+    numbering); constant/outside-cone roots hash as 0."""
+    for lit in roots:
+        dense_var = dense.get(lit >> 1) or 0
+        digest.update(struct.pack("<q", (dense_var << 1) | (lit & 1)))
+
+
+def instance_fingerprint(prep) -> Optional[str]:
+    """sha256 hex digest of `prep`'s blasted instance in canonical form,
+    or None when the instance has no blasted CNF (trivial verdicts)."""
+    clauses = getattr(prep, "clauses", None)
+    if clauses is None or getattr(prep, "blaster", None) is None:
+        return None
+    digest = hashlib.sha256()
+    digest.update(b"mythril-tpu-solve-v%d:" % FINGERPRINT_SCHEMA)
+    _digest_cnf(digest, prep.num_vars, clauses)
     if prep.aig_roots is not None:
         _aig, roots, dense = prep.aig_roots
-        for lit in roots:
-            dense_var = dense.get(lit >> 1) or 0
-            digest.update(struct.pack("<q", (dense_var << 1) | (lit & 1)))
+        _digest_roots(digest, roots, dense)
+    return digest.hexdigest()
+
+
+def component_fingerprint(num_vars: int, clauses, roots, dense) -> str:
+    """sha256 hex digest of ONE partitioned component's sub-instance
+    (its dense-renumbered CNF + projected roots in the same numbering).
+    Domain-separated from whole-instance fingerprints so a monolithic
+    entry can never alias a component of the same shape."""
+    digest = hashlib.sha256()
+    digest.update(b"mythril-tpu-component-v%d:" % FINGERPRINT_SCHEMA)
+    _digest_cnf(digest, num_vars, clauses)
+    _digest_roots(digest, roots, dense)
     return digest.hexdigest()

@@ -478,10 +478,53 @@ class Solver:
             bits = prep.blaster.bv_bits(lowered_obj)
             prep.objective_bits.append(bits)
             objective_lits.extend(bits)
-        prep.num_vars, prep.clauses, prep.var_dense = prep.blaster.cnf(
-            lowered, objective_lits)
-        prep.aig_roots = (prep.blaster.aig, list(prep.blaster.last_roots),
-                          prep.var_dense)
+        # AIG structural analysis & rewriting (preanalysis/aig_opt.py):
+        # the blasted cone is swept (root-forced constants propagated,
+        # dead fanout pruned, trivially-UNSAT roots detected — the
+        # verdict still settles through the CDCL so the detection-path
+        # crosscheck policy survives) and re-strashed BEFORE the CNF is
+        # emitted, so the fingerprint, the router's PackedCircuit, and
+        # the host CDCL all consume the smaller rewritten instance.
+        # Withheld under Optimize objectives: bit probes assume over
+        # objective-bit literals of the ORIGINAL shared AIG, and the
+        # rewrite could fold those gates away. prep.var_dense stays in
+        # ORIGINAL global numbering (composed through the rewrite's
+        # input map) so _reconstruct — which validates every model
+        # against the original constraints — works unchanged, while
+        # prep.aig_roots carries the rewritten (aig, roots, dense) the
+        # device path and fingerprint consume.
+        aig_opted = False
+        if not objectives:
+            from mythril_tpu.preanalysis import aig_opt
+
+            if aig_opt.enabled():
+                roots = [prep.blaster.assert_bool(t) for t in lowered]
+                prep.blaster.last_roots = roots
+                opt = aig_opt.optimize_roots_cached(prep.blaster.aig, roots)
+                if opt is not None:
+                    prep.num_vars, prep.clauses, opt_dense = opt.aig.to_cnf(
+                        list(opt.roots))
+                    prep.aig_roots = (opt.aig, list(opt.roots), opt_dense)
+                    prep.var_dense = aig_opt.ComposedDense(
+                        opt.input_map, opt_dense)
+                    stats = SolverStatistics()
+                    stats.add_aig_opt(
+                        opt.nodes_before, opt.nodes_after,
+                        opt.strash_merges, opt.const_folds,
+                        trivial_unsat=opt.trivially_unsat)
+                    from mythril_tpu.preanalysis import aig_partition
+
+                    partition = aig_partition.partition_cached(
+                        opt.aig, opt.roots)
+                    if partition is not None:
+                        stats.add_aig_components(len(partition.components))
+                    aig_opted = True
+        if not aig_opted:
+            prep.num_vars, prep.clauses, prep.var_dense = prep.blaster.cnf(
+                lowered, objective_lits)
+            prep.aig_roots = (prep.blaster.aig,
+                              list(prep.blaster.last_roots),
+                              prep.var_dense)
         prep.symbols = {
             (name, sort)
             for (name, sort) in terms.free_symbols(
@@ -512,9 +555,16 @@ class Solver:
             # the device path: the circuit kernel searches the ORIGINAL
             # AIG's model space, and a model putting a pure-pinned
             # variable at the opposite polarity would fail the clause
-            # check against the pinned CNF — a wasted device hit
+            # check against the pinned CNF — a wasted device hit. An
+            # AIG-rewritten instance is ALWAYS treated as device-possible
+            # here: its (aig, roots, dense) triple is a self-contained
+            # dispatchable artifact (harvest/dryrun paths re-solve it on
+            # device regardless of the configured backend), and the sweep
+            # routinely leaves single-polarity literals the pure rule
+            # would otherwise pin against the kernel's model space.
             device_possible = (
-                _args.solver_backend == "tpu" and self.allow_device)
+                (_args.solver_backend == "tpu" and self.allow_device)
+                or aig_opted)
             simplified = preprocess_cnf(
                 prep.num_vars, prep.clauses,
                 allow_pure=not objectives and not device_possible)

@@ -48,6 +48,17 @@ class SolverStatistics:
         "cnf_clauses_removed",
         "cnf_components_split",
         "router_dispatched_clauses",
+        # AIG structural analysis & rewriting (preanalysis/aig_opt.py):
+        # per-instance cone sizes before/after the strash+sweep rewrite,
+        # what each pass removed, and how the partition projected onto the
+        # device path (preanalysis/aig_partition.py + tpu/router.py)
+        "aig_nodes_before",
+        "aig_nodes_after",
+        "aig_strash_merges",
+        "aig_const_folds",
+        "aig_trivial_unsat",
+        "aig_components",
+        "aig_device_components",
     )
     _TIMERS = (
         "solver_time",
@@ -215,6 +226,35 @@ class SolverStatistics:
         if self.enabled:
             self.cnf_components_split += components
 
+    def add_aig_opt(self, nodes_before: int, nodes_after: int,
+                    strash_merges: int, const_folds: int,
+                    trivial_unsat: bool = False) -> None:
+        """One blasted cone rewritten by the AIG strash/sweep passes
+        (preanalysis/aig_opt.py) before CNF emission, fingerprinting and
+        dispatch. A statically-proven-UNSAT root set is counted but the
+        verdict still settles through the CDCL (crosscheck policy)."""
+        if self.enabled:
+            self.aig_nodes_before += nodes_before
+            self.aig_nodes_after += nodes_after
+            self.aig_strash_merges += strash_merges
+            self.aig_const_folds += const_folds
+            if trivial_unsat:
+                self.aig_trivial_unsat += 1
+
+    def add_aig_components(self, components: int) -> None:
+        """One optimized cone partitioned into `components` variable-
+        disjoint sub-cones at the AIG level (counted per prepared
+        instance, whether or not the router later dispatches them)."""
+        if self.enabled:
+            self.aig_components += components
+
+    def add_aig_device_components(self, components: int) -> None:
+        """Partitioned sub-cones that rode a device dispatch individually
+        (the per-component root projection the router performs for
+        multi-component instances)."""
+        if self.enabled:
+            self.aig_device_components += components
+
     def add_router_clauses(self, clauses: int) -> None:
         """CNF clause volume of queries reaching the device router —
         preprocessed shrinkage shows up here as smaller dispatched cones."""
@@ -305,6 +345,14 @@ class SolverStatistics:
                     f"+{self.cnf_pure_literals} pures propagated"
                     f" ({self.cnf_clauses_removed} clauses removed,"
                     f" {self.cnf_components_split} components split)")
+        if self.aig_nodes_before:
+            out += (f", aig opt: {self.aig_nodes_before}"
+                    f"->{self.aig_nodes_after} nodes"
+                    f" ({self.aig_strash_merges} strash merges,"
+                    f" {self.aig_const_folds} const folds,"
+                    f" {self.aig_trivial_unsat} trivially unsat,"
+                    f" {self.aig_components} components"
+                    f"/{self.aig_device_components} on device)")
         if self.crosscheck_runs or self.crosscheck_cap_skips:
             out += (f", unsat crosschecks: {self.crosscheck_runs}"
                     f" (+{self.crosscheck_cap_skips} cap-skipped)")

@@ -135,6 +135,92 @@ def _persistent_store():
     return store if store.available else None
 
 
+def _prep_partition(prep):
+    """The AIG-level partition of a prepared instance's rewritten cone
+    (preanalysis/aig_partition.py), or None for monolithic instances —
+    the same gate the router's component dispatch uses."""
+    aig_roots = getattr(prep, "aig_roots", None)
+    if not aig_roots:
+        return None
+    try:
+        from mythril_tpu.preanalysis import aig_partition
+
+        return aig_partition.partition_for_aig_roots(aig_roots)
+    except Exception:
+        return None
+
+
+def _probe_component_assembly(store, solver, prep, stats):
+    """Disk-tier probe at COMPONENT granularity: when the monolithic
+    fingerprint misses but every non-trivial component of the partitioned
+    instance has a stored SAT sub-model, the components reassemble into a
+    full model — so a sub-cone shared by different parent queries hits
+    across them. The recomposed assignment goes through Solver._reconstruct
+    (validated against the ORIGINAL constraints) exactly like a monolithic
+    replay: any staleness or collision degrades to a safe miss. Returns
+    the ("sat", Model, True) outcome or None."""
+    partition = _prep_partition(prep)
+    if partition is None:
+        return None
+    from mythril_tpu.preanalysis.aig_partition import (
+        apply_trivial_assignment,
+        component_vars,
+        merge_component_bits,
+    )
+    from mythril_tpu.service.fingerprint import component_fingerprint
+
+    aig, dense_q = prep.aig_roots[0], prep.aig_roots[2]
+    merged = [False] * (prep.num_vars + 1)
+    try:
+        for component in partition.components:
+            if apply_trivial_assignment(component, dense_q, merged):
+                continue
+            comp_nv, comp_cnf, comp_dense = component.instance(aig)
+            fingerprint = component_fingerprint(
+                comp_nv, comp_cnf, component.roots, comp_dense)
+            entry = store.lookup(fingerprint)
+            if entry is None or entry.verdict != "sat" \
+                    or entry.num_vars != comp_nv or entry.bits is None:
+                return None
+            merge_component_bits(
+                comp_dense, dense_q, component_vars(comp_dense),
+                entry.bits, merged)
+        model = solver._reconstruct(prep, merged)
+    except Exception:
+        stats.add_persistent_verify_reject()
+        return None
+    return ("sat", model, True)
+
+
+def _persist_component_entries(store, prep, bits, stats) -> None:
+    """Store each non-trivial component's sub-model under its own
+    fingerprint so later queries sharing the sub-cone (under any parent)
+    can reassemble it from disk."""
+    partition = _prep_partition(prep)
+    if partition is None or bits is None:
+        return
+    from mythril_tpu.preanalysis.aig_partition import component_vars
+    from mythril_tpu.service.fingerprint import component_fingerprint
+
+    aig, dense_q = prep.aig_roots[0], prep.aig_roots[2]
+    try:
+        for component in partition.components:
+            if component.trivial_assignment is not None:
+                continue  # units reassemble for free; nothing to store
+            comp_nv, comp_cnf, comp_dense = component.instance(aig)
+            comp_bits = [False] * (comp_nv + 1)
+            for gvar in component_vars(comp_dense):
+                qvar = dense_q.get(int(gvar))
+                if qvar is not None and qvar < len(bits):
+                    comp_bits[comp_dense.arr[gvar]] = bool(bits[qvar])
+            fingerprint = component_fingerprint(
+                comp_nv, comp_cnf, component.roots, comp_dense)
+            if store.store_sat(fingerprint, comp_nv, comp_bits):
+                stats.add_persistent_store()
+    except Exception:
+        pass  # persistence is best-effort; never break a solve
+
+
 def _probe_persistent(solver, prep, crosscheck, stats):
     """Disk-tier lookup for a blasted instance.
 
@@ -162,8 +248,11 @@ def _probe_persistent(solver, prep, crosscheck, stats):
         return None, None
     entry = store.lookup(fingerprint)
     if entry is None:
-        stats.add_persistent_lookup(hit=False)
-        return fingerprint, None
+        # monolithic miss: a partitioned instance may still reassemble
+        # from per-component entries stored by different parent queries
+        assembled = _probe_component_assembly(store, solver, prep, stats)
+        stats.add_persistent_lookup(hit=assembled is not None)
+        return fingerprint, assembled
     if entry.verdict == "sat":
         if entry.num_vars != prep.num_vars:
             stats.add_persistent_verify_reject()
@@ -214,6 +303,7 @@ def _persist_result(fingerprint, prep, status, bits=None,
         return
     if status == SAT:
         stored = store.store_sat(fingerprint, prep.num_vars, bits)
+        _persist_component_entries(store, prep, bits, stats)
     elif status == UNSAT:
         stored = store.store_unsat(
             fingerprint, crosschecked=_crosscheck_confirmed(crosscheck))

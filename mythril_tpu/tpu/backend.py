@@ -258,9 +258,7 @@ class DeviceSolverBackend:
         pack cache; returns the PackedCircuit or None on a pre-pack var-cap
         reject. Shared by try_solve_batch_circuit and the router's bucketing
         pass — one pack, one cache, one cap-counting path."""
-        from mythril_tpu.tpu import circuit
-
-        num_vars, _clauses, aig_roots = problem
+        num_vars, _clauses, aig_roots = problem[:3]
         if num_vars + 1 > v1_cap:
             # the cone has num_vars+1 circuit variables — past the
             # platform cap it can never run; rejecting BEFORE the
@@ -270,6 +268,15 @@ class DeviceSolverBackend:
             self.count_cap_reject()
             return None
         aig, roots = aig_roots[0], aig_roots[1]
+        return self.pack_cone(aig, roots)
+
+    def pack_cone(self, aig, roots):
+        """Levelize one root cone through the pack cache (no pre-pack
+        var-cap shortcut — component sub-cones are smaller than their
+        parent query's num_vars, so the caller applies caps on the packed
+        result instead)."""
+        from mythril_tpu.tpu import circuit
+
         skey = _circuit_struct_key(aig, roots)
         pc, hit = self._pack_cache.get_or(
             skey, lambda: circuit.PackedCircuit(aig, roots))

@@ -159,6 +159,15 @@ class PackedCircuit:
         )
         self.ok = True
 
+    @classmethod
+    def from_component(cls, aig, component) -> "PackedCircuit":
+        """Construct-from-subgraph path: pack one partitioned sub-cone
+        (preanalysis/aig_partition.AIGComponent). The component's
+        projected root set levelizes exactly like a whole-query cone —
+        its own local variable space, the same kernel — so split
+        sub-cones ride the device path individually."""
+        return cls(aig, list(component.roots))
+
     def padded_to(self, num_levels, max_width, v1, num_roots) -> dict:
         """Copy tensors into a shared batch shape (for query-axis vmap)."""
         def pad2(a):

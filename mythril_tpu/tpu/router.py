@@ -84,6 +84,37 @@ from mythril_tpu.tpu.backend import shape_bucket
 
 log = logging.getLogger(__name__)
 
+
+class _Unit:
+    """One device-dispatch unit: a whole monolithic query, or one
+    projected component of a partitioned query (preanalysis/aig_partition
+    — the per-component AIG-root projection)."""
+
+    __slots__ = ("qi", "component", "pc", "problem", "comp_dense",
+                 "resolved")
+
+    def __init__(self, qi, component, pc, problem, comp_dense=None):
+        self.qi = qi
+        self.component = component  # AIGComponent or None (monolith)
+        self.pc = pc
+        self.problem = problem      # (num_vars, clauses, aig_roots)
+        self.comp_dense = comp_dense
+        self.resolved = False
+
+
+class _SplitState:
+    """Merge state of one partitioned query: trivial components write
+    their literals directly, device/host-solved components merge their
+    sub-models, and the recomposed assignment only stands after passing
+    the full-query clause check."""
+
+    __slots__ = ("merged", "units", "host")
+
+    def __init__(self, num_vars: int):
+        self.merged = [False] * (num_vars + 1)
+        self.units: List[_Unit] = []   # non-trivial components
+        self.host: List[_Unit] = []    # settle on the host CDCL in-router
+
 # raised defaults (round-5 fix): production 256-bit analyze cones levelize
 # at ~513-540 through the get_model path and ~772-800 at the batched
 # fork-pruning seam (the balance-update borrow chains ride every message
@@ -383,7 +414,19 @@ class QueryRouter:
         """Route a batch of blasted sibling queries: tiny cones host-direct,
         oversize cones cap-rejected (counted), the rest level-bucketed into
         padded device batches under one shared deadline. Returns per-query
-        model bits or None (the caller's CDCL settles None)."""
+        model bits or None (the caller's CDCL settles None).
+
+        Queries whose optimized AIG partitions into variable-disjoint
+        components (preanalysis/aig_partition.py) dispatch at COMPONENT
+        granularity: each sub-cone gets its own projected root set, dense
+        remap and PackedCircuit, device-eligible components join the level
+        buckets individually, trivial components settle inline, and
+        oversized/missed ones settle on the host CDCL in-router — so a
+        deep monolith with small independent sub-cones no longer forfeits
+        the device path. A fully recomposed model is returned only after
+        it passes the whole query's clause check; anything less leaves
+        the query to the caller's CDCL (which alone proves UNSAT, under
+        the standard crosscheck policy)."""
         results: List[Optional[List[bool]]] = [None] * len(problems)
         if not problems or not self.device_usable():
             return results
@@ -416,10 +459,10 @@ class QueryRouter:
         else:
             profile = {}
 
-        buckets = {}  # bucket level -> list of query indices
-        packed = {}   # query index -> PackedCircuit (forwarded to backend)
+        buckets = {}  # bucket level -> list of _Unit
+        states = {}   # query index -> _SplitState (partitioned queries)
         for qi, problem in enumerate(problems):
-            num_vars, clauses, aig_roots = problem
+            num_vars, clauses, aig_roots = problem[:3]
             if num_vars == 0 or aig_roots is None:
                 continue
             if stats is not None:
@@ -427,42 +470,41 @@ class QueryRouter:
                 # preprocessor's shrinkage is visible here as smaller
                 # dispatched cones (bench compares preanalysis on/off)
                 stats.add_router_clauses(len(clauses))
+            partition = self._partition_for(aig_roots)
+            if partition is not None:
+                state = self._plan_components(
+                    qi, num_vars, aig_roots, partition, caps, buckets,
+                    stats)
+                if state is not None:
+                    states[qi] = state
+                    continue
             pc = self.backend.pack_problem(problem, v1_cap)
             if pc is None:  # pre-pack var-cap reject (counted by backend)
                 continue
-            packed[qi] = pc
             if not pc.ok:
                 continue  # trivially unsat roots: CDCL proves it
-            if (pc.num_levels > level_cap
-                    or pc.num_levels * pc.max_width > cell_cap
-                    or pc.v1 > v1_cap):
+            verdict = self._admission(pc, caps)
+            if verdict == "cap":
                 self.backend.count_cap_reject(
                     under_floor=(pc.num_levels <= LEVEL_CAP_FLOOR
                                  and pc.num_levels * pc.max_width
                                  <= self.CELL_FLOOR))
                 continue
-            if pc.num_levels <= self.host_direct_levels:
+            if verdict == "tiny":
                 # cost model: propagation-only cones — the host CDCL settles
                 # these in microseconds; a device slot would be pure overhead
                 if stats is not None:
                     stats.add_host_direct()
                 continue
-            under_floor = (pc.num_levels <= LEVEL_CAP_FLOOR
-                           and pc.num_levels * pc.max_width
-                           <= self.CELL_FLOOR)
-            if (not under_floor
-                    and self.est_round_seconds(pc.num_levels, pc.max_width)
-                    > self.round_budget_s):
+            if verdict == "cost":
                 # cost model: ONE kernel round at this size already blows
                 # the round budget, so the dispatch deadline could never be
                 # honored — host takes it (counted like a cap reject: the
-                # cone was device-eligible by size, the clock rejected it).
-                # Cones inside the level x cell floor are exempt: their
-                # admission is the round-5 guarantee, and the dispatch
-                # deadline still bounds what they may cost
+                # cone was device-eligible by size, the clock rejected it)
                 self.backend.count_cap_reject()
                 continue
-            buckets.setdefault(shape_bucket(pc.num_levels), []).append(qi)
+            buckets.setdefault(shape_bucket(pc.num_levels), []).append(
+                _Unit(qi, None, pc, problem))
 
         deadline = time.monotonic() + budget
         # biggest group first: under the evidence-mode dispatch cap and the
@@ -479,6 +521,10 @@ class QueryRouter:
                 # conflated with the tiny-cone host shortcut)
                 if stats is not None:
                     stats.add_slot_overflow(len(group) - max_slots)
+                for unit in group[max_slots:]:
+                    if unit.component is not None:
+                        unit.resolved = True
+                        states[unit.qi].host.append(unit)
                 group = group[:max_slots]
             remaining = deadline - time.monotonic()
             if remaining <= 0.1:
@@ -486,10 +532,10 @@ class QueryRouter:
             t0 = time.monotonic()
             try:
                 group_bits = self.backend.try_solve_batch_circuit(
-                    [problems[qi] for qi in group],
+                    [unit.problem for unit in group],
                     budget_seconds=remaining,
                     size_caps=caps,
-                    packed_hint=[packed[qi] for qi in group],
+                    packed_hint=[unit.pc for unit in group],
                     **profile,
                 )
             except Exception as error:
@@ -506,9 +552,167 @@ class QueryRouter:
                         len(group), single_device=evidence),
                     elapsed)
             self.record_dispatch(hits, elapsed)
-            for qi, bits in zip(group, group_bits):
-                results[qi] = bits
+            device_components = 0
+            for unit, bits in zip(group, group_bits):
+                if unit.component is None:
+                    results[unit.qi] = bits
+                    continue
+                # a projected sub-cone rode the device path individually
+                device_components += 1
+                unit.resolved = True
+                state = states[unit.qi]
+                if bits is not None:
+                    from mythril_tpu.preanalysis.aig_partition import (
+                        component_vars,
+                        merge_component_bits,
+                    )
+
+                    merge_component_bits(
+                        unit.comp_dense, problems[unit.qi][2][2],
+                        component_vars(unit.comp_dense), bits,
+                        state.merged)
+                else:
+                    state.host.append(unit)
+            if stats is not None and device_components:
+                stats.add_aig_device_components(device_components)
+        if states:
+            self._settle_components(states, results, problems, timeout_s,
+                                    stats)
         return results
+
+    def _admission(self, pc, caps) -> str:
+        """THE device-admission policy, shared by monolithic queries and
+        projected components so the two can never route under diverging
+        rules: "cap" (past the size caps), "tiny" (host CDCL settles it
+        by propagation), "cost" (one round blows the round budget; cones
+        inside the level x cell floor are exempt — their admission is
+        the round-5 guarantee, and the dispatch deadline still bounds
+        what they may cost), or "device"."""
+        level_cap, cell_cap, v1_cap = caps
+        if (pc.num_levels > level_cap
+                or pc.num_levels * pc.max_width > cell_cap
+                or pc.v1 > v1_cap):
+            return "cap"
+        if pc.num_levels <= self.host_direct_levels:
+            return "tiny"
+        under_floor = (pc.num_levels <= LEVEL_CAP_FLOOR
+                       and pc.num_levels * pc.max_width <= self.CELL_FLOOR)
+        if (not under_floor
+                and self.est_round_seconds(pc.num_levels, pc.max_width)
+                > self.round_budget_s):
+            return "cost"
+        return "device"
+
+    # -- per-component root projection (preanalysis/aig_partition) ----------
+
+    @staticmethod
+    def _partition_for(aig_roots):
+        """The AIG-level partition of a query's root set, or None for
+        monolithic dispatch (one shared gate with the disk tier's
+        component assembly — aig_partition.partition_for_aig_roots)."""
+        try:
+            from mythril_tpu.preanalysis import aig_partition
+
+            return aig_partition.partition_for_aig_roots(aig_roots)
+        except Exception:
+            return None  # partitioning must never break routing
+
+    def _plan_components(self, qi, num_vars, aig_roots, partition, caps,
+                         buckets, stats) -> Optional["_SplitState"]:
+        """Project a partitioned query onto dispatch units: trivial
+        components (all-unit root sets) write their literals into the
+        merge state directly, device-eligible components join the level
+        buckets individually, and everything else settles on the host
+        CDCL inside _settle_components. Returns None when the query
+        should take the monolithic path instead (missing dense map or
+        emission failure)."""
+        from mythril_tpu.preanalysis.aig_partition import (
+            apply_trivial_assignment,
+        )
+
+        aig, dense_q = aig_roots[0], aig_roots[2]
+        state = _SplitState(num_vars)
+        try:
+            for component in partition.components:
+                if apply_trivial_assignment(component, dense_q,
+                                            state.merged):
+                    continue
+                pc = self.backend.pack_cone(aig, component.roots)
+                comp_nv, comp_cnf, comp_dense = component.instance(aig)
+                unit = _Unit(
+                    qi, component, pc,
+                    (comp_nv, comp_cnf,
+                     (aig, list(component.roots), comp_dense)),
+                    comp_dense)
+                state.units.append(unit)
+                # not pc.ok here means the cone is past the device
+                # COMPILE caps (MAX_LEVELS/MAX_VARS) — the partition
+                # never projects constant roots, so it cannot mean a
+                # trivially-unsat root set — and routes host like any
+                # other ineligible component
+                if pc.ok and self._admission(pc, caps) == "device":
+                    buckets.setdefault(
+                        shape_bucket(pc.num_levels), []).append(unit)
+                else:
+                    # oversized / tiny component: host CDCL settles it
+                    # in-router (no cap-reject counted — nothing is
+                    # silently dropped, the sub-cone is deliberately
+                    # routed host while its siblings ride the device)
+                    unit.resolved = True
+                    state.host.append(unit)
+        except Exception:
+            log.warning("component projection failed; monolithic dispatch",
+                        exc_info=True)
+            return None
+        return state
+
+    def _settle_components(self, states, results, problems, timeout_s,
+                           stats) -> None:
+        """Finish partitioned queries: host-settle leftover components
+        (device misses, oversized/tiny sub-cones, never-dispatched units)
+        under a bounded budget, then accept the recomposed model only if
+        it satisfies the FULL query CNF. Any component that cannot be
+        settled — including an UNSAT one — leaves the query to the
+        caller's CDCL, which alone proves UNSAT (and applies the
+        detection-path crosscheck policy)."""
+        from mythril_tpu.smt.solver import sat_backend
+        from mythril_tpu.preanalysis.aig_partition import (
+            component_vars,
+            merge_component_bits,
+        )
+        from mythril_tpu.tpu.backend import DeviceSolverBackend
+
+        host_budget = min(0.5 * timeout_s, 5.0) if timeout_s else 2.5
+        host_deadline = time.monotonic() + host_budget
+        for qi, state in states.items():
+            leftovers = state.host + [
+                u for u in state.units if not u.resolved]
+            complete = True
+            for unit in leftovers:
+                remaining = host_deadline - time.monotonic()
+                if remaining <= 0.05:
+                    complete = False
+                    break
+                comp_nv, comp_cnf = unit.problem[0], unit.problem[1]
+                t0 = time.monotonic()
+                status, bits = sat_backend.solve_cnf(
+                    comp_nv, comp_cnf, timeout_seconds=remaining,
+                    allow_device=False)
+                if stats is not None:
+                    stats.add_host_route_seconds(time.monotonic() - t0)
+                if status != sat_backend.SAT:
+                    complete = False
+                    break
+                merge_component_bits(
+                    unit.comp_dense, problems[qi][2][2],
+                    component_vars(unit.comp_dense), bits, state.merged)
+            if not complete:
+                continue
+            # recomposition soundness net: the merged assignment must
+            # satisfy the whole query's CNF (the caller's _reconstruct
+            # then re-validates it against the original constraints)
+            if DeviceSolverBackend._honors(state.merged, problems[qi][1]):
+                results[qi] = state.merged
 
 
 _router: Optional[QueryRouter] = None

@@ -1,0 +1,608 @@
+"""AIG structural analysis & rewriting tests (preanalysis/aig_opt.py +
+aig_partition.py): semantic preservation of the strash/sweep rewrite
+against random simulation, end-to-end equisatisfiability through
+Solver._reconstruct on random word-level instances, per-component root
+projection and remerge, the trivially-UNSAT crosscheck policy, counters,
+and findings parity with MYTHRIL_TPU_AIG_OPT on vs off."""
+
+import json
+import random
+
+import pytest
+
+from mythril_tpu.preanalysis import aig_opt, aig_partition
+from mythril_tpu.smt import Extract, ULT, symbol_factory
+from mythril_tpu.smt.bitblast import AIG, FALSE_LIT, TRUE_LIT
+from mythril_tpu.smt.solver import sat_backend
+from mythril_tpu.smt.solver.frontend import Solver
+from mythril_tpu.smt.solver.statistics import SolverStatistics
+from mythril_tpu.support.args import args
+from mythril_tpu.tpu.circuit import PackedCircuit
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    args.reset()
+    aig_opt.reset_cache()
+    aig_partition.reset_cache()
+    from mythril_tpu.support.model import clear_caches
+
+    clear_caches()
+    yield
+    args.reset()
+    aig_opt.reset_cache()
+    aig_partition.reset_cache()
+
+
+def _stats():
+    stats = SolverStatistics()
+    stats.reset()
+    stats.enabled = True
+    return stats
+
+
+# -- semantic preservation against random simulation -------------------------
+
+
+def _random_cone(rng: random.Random):
+    """A random AIG cone: a few inputs, a soup of and/or/xor/mux gates,
+    and a root set that mixes gate literals and raw input literals (the
+    unit-root shape the sweep exploits)."""
+    aig = AIG()
+    inputs = [aig.new_var() for _ in range(rng.randint(2, 6))]
+    literals = [2 * v for v in inputs] + [2 * v + 1 for v in inputs]
+    for _ in range(rng.randint(2, 24)):
+        a, b = rng.choice(literals), rng.choice(literals)
+        kind = rng.randrange(4)
+        if kind == 0:
+            lit = aig.and_gate(a, b)
+        elif kind == 1:
+            lit = aig.or_gate(a, b)
+        elif kind == 2:
+            lit = aig.xor_gate(a, b)
+        else:
+            lit = aig.mux(rng.choice(literals), a, b)
+        literals.append(lit)
+        literals.append(lit ^ 1)
+    roots = [rng.choice(literals) for _ in range(rng.randint(1, 5))]
+    return aig, inputs, roots
+
+
+def test_rewrite_preserves_semantics_under_random_simulation():
+    """For EVERY total input assignment, the rewritten cone's root
+    conjunction must agree with the original's (pointwise — stronger than
+    equisatisfiability): 300 random cones x 24 random assignments, values
+    transferred through the recorded input_map."""
+    rng = random.Random(0x51A5)
+    rewritten = 0
+    for trial in range(300):
+        aig, inputs, roots = _random_cone(rng)
+        opt = aig_opt.optimize_roots(aig, roots)
+        if opt is None:
+            continue
+        rewritten += 1
+        for _ in range(24):
+            values = {v: rng.random() < 0.5 for v in inputs}
+            original = aig_opt.evaluate_roots(aig, roots, values)
+            if opt.trivially_unsat:
+                assert not original, \
+                    f"trial {trial}: statically-UNSAT cone has a model"
+                continue
+            mapped = {
+                new_var: values[orig_var]
+                for orig_var, new_var in opt.input_map.items()
+                if orig_var in values
+            }
+            assert aig_opt.evaluate_roots(opt.aig, opt.roots, mapped) \
+                == original, f"trial {trial}: rewrite changed semantics"
+    assert rewritten >= 50, "rewrite never fired: generator too tame"
+
+
+def test_rewrite_shrinks_and_counts_on_selector_cone():
+    """The canonical win: a pinned selector collapses the arithmetic
+    cones sharing its bits; every pass reports its work."""
+    data = symbol_factory.BitVecSym("aigopt_data", 64)
+    value = symbol_factory.BitVecSym("aigopt_value", 64)
+    solver = Solver(timeout=20.0)
+    solver.add((data >> 32) == 0x41C0E1B5)
+    solver.add(ULT(value, symbol_factory.BitVecVal(1 << 24, 64)))
+    solver.add(value + data != 77)
+    stats = _stats()
+    assert solver.check() == "sat"
+    assert stats.aig_nodes_before > 0
+    assert stats.aig_nodes_after < stats.aig_nodes_before
+    assert stats.aig_const_folds > 0
+    assert stats.aig_components > 1  # pinned selector bits split off
+    # the model honors the pinned selector (validated by _reconstruct
+    # against the ORIGINAL constraints, but assert the visible bits too)
+    model = solver.model()
+    assert (model.assignment["aigopt_data"] >> 32) == 0x41C0E1B5
+
+
+# -- end-to-end equisatisfiability through _reconstruct ----------------------
+
+
+_BIN_OPS = ("add", "sub", "mul", "and", "or", "xor")
+
+
+def _random_word_instance(rng: random.Random, tag: str):
+    """1-3 random 8-bit constraints over up to 3 symbols, salted with the
+    comparison/extract shapes that pin bits (the sweep's food)."""
+    syms = [symbol_factory.BitVecSym(f"ri_{tag}_{i}", 8)
+            for i in range(rng.randint(1, 3))]
+
+    def expr(depth):
+        if depth == 0 or rng.random() < 0.4:
+            if rng.random() < 0.5:
+                return rng.choice(syms)
+            return symbol_factory.BitVecVal(rng.randrange(256), 8)
+        a, b = expr(depth - 1), expr(depth - 1)
+        op = rng.choice(_BIN_OPS)
+        if op == "add":
+            return a + b
+        if op == "sub":
+            return a - b
+        if op == "mul":
+            return a * b
+        if op == "and":
+            return a & b
+        if op == "or":
+            return a | b
+        return a ^ b
+
+    constraints = []
+    for _ in range(rng.randint(1, 3)):
+        kind = rng.randrange(4)
+        if kind == 0:
+            constraints.append(expr(2) == expr(2))
+        elif kind == 1:
+            constraints.append(expr(2) != expr(2))
+        elif kind == 2:
+            constraints.append(ULT(expr(1), expr(1)))
+        else:
+            sym = rng.choice(syms)
+            bit = rng.randrange(8)
+            constraints.append(
+                Extract(bit, bit, sym)
+                == symbol_factory.BitVecVal(rng.randrange(2), 1))
+    return constraints
+
+
+def test_equisatisfiability_through_reconstruct_random_property():
+    """300 random word-level instances solved with the rewrite ON must
+    agree with the rewrite OFF on SAT/UNSAT, and every SAT model has
+    already passed _reconstruct's validation against the ORIGINAL
+    constraints (a wrong rewrite raises SolverInternalError or flips a
+    verdict — both fail here)."""
+    rng = random.Random(0xA16)
+    flips = 0
+    rewrites = 0
+    for trial in range(300):
+        constraints = _random_word_instance(rng, str(trial))
+        verdicts = {}
+        for label in ("on", "off"):
+            args.no_aig_opt = label == "off"
+            stats = _stats()
+            solver = Solver(timeout=20.0)
+            solver.add(constraints)
+            verdicts[label] = solver.check()
+            if label == "on" \
+                    and getattr(solver, "last_prep", None) is not None \
+                    and stats.aig_nodes_before:
+                rewrites += 1
+        if verdicts["on"] != verdicts["off"]:
+            flips += 1
+    assert flips == 0
+    assert rewrites >= 30, "rewrite never fired on the random instances"
+
+
+def test_trivially_unsat_settles_through_cdcl_crosscheck_policy():
+    """A statically proven UNSAT must NOT short-circuit: the verdict
+    settles through the CDCL, so the detection-path crosscheck runs
+    exactly as it would have."""
+    x = symbol_factory.BitVecSym("aigopt_trivial_x", 8)
+    stats = _stats()
+    solver = Solver(timeout=20.0)
+    solver.unsat_crosscheck = True  # the detection-context policy
+    solver.add(Extract(0, 0, x) == symbol_factory.BitVecVal(1, 1))
+    solver.add(Extract(0, 0, x) == symbol_factory.BitVecVal(0, 1))
+    assert solver.check() == "unsat"
+    assert stats.aig_trivial_unsat == 1
+    assert stats.cdcl_settles >= 1, "verdict must come from the CDCL"
+    assert stats.crosscheck_runs >= 1, \
+        "detection-path UNSAT lost its second opinion"
+
+
+def test_flag_and_env_gates(monkeypatch):
+    data = symbol_factory.BitVecSym("aigopt_gate_d", 16)
+    constraints = [(data & 0xF) == 5, data + 3 != 9]
+
+    def nodes_with(no_flag, env):
+        args.no_aig_opt = no_flag
+        if env is None:
+            monkeypatch.delenv("MYTHRIL_TPU_AIG_OPT", raising=False)
+        else:
+            monkeypatch.setenv("MYTHRIL_TPU_AIG_OPT", env)
+        aig_opt.reset_cache()
+        stats = _stats()
+        solver = Solver(timeout=20.0)
+        solver.add(constraints)
+        assert solver.check() == "sat"
+        return stats.aig_nodes_before
+
+    assert nodes_with(False, None) > 0          # default: on
+    assert nodes_with(True, None) == 0          # --no-aig-opt
+    assert nodes_with(True, "1") > 0            # env force-enable wins
+    assert nodes_with(False, "0") == 0          # env force-disable wins
+    args.no_preanalysis = True                  # master switch gates all
+    assert nodes_with(False, "1") == 0
+
+
+# -- partition + remerge -----------------------------------------------------
+
+
+def _disjoint_prep():
+    """Two variable-disjoint groups plus a pinned nibble (a trivial unit
+    component) -> a multi-component optimized instance."""
+    a = symbol_factory.BitVecSym("aigp_a", 32)
+    b = symbol_factory.BitVecSym("aigp_b", 32)
+    c = symbol_factory.BitVecSym("aigp_c", 32)
+    d = symbol_factory.BitVecSym("aigp_d", 32)
+    solver = Solver(timeout=20.0)
+    solver.add(a + b != 3, (a & 0xF0F0) != 0, b != a)
+    solver.add(c * 3 != d, (d | 1) != c)
+    prep = solver._prepare([])
+    assert prep.trivial is None
+    return solver, prep
+
+
+def test_partition_projects_roots_and_remerges_through_reconstruct():
+    """Per-component root projection: each component's own dense remap +
+    CNF solves independently; the merged full-space assignment passes
+    Solver._reconstruct (which validates against the ORIGINAL word-level
+    constraints, so a wrong merge raises)."""
+    import numpy as np
+
+    solver, prep = _disjoint_prep()
+    aig, roots, dense_q = prep.aig_roots
+    assert getattr(aig, "_aig_opt_cone", False), "instance was not rewritten"
+    partition = aig_partition.partition_cached(aig, roots)
+    assert partition is not None and len(partition.components) >= 2
+    merged = [False] * (prep.num_vars + 1)
+    for component in partition.components:
+        if aig_partition.apply_trivial_assignment(component, dense_q,
+                                                  merged):
+            continue
+        comp_nv, comp_cnf, comp_dense = component.instance(aig)
+        verdict, bits = sat_backend.solve_cnf(
+            comp_nv, comp_cnf, timeout_seconds=20.0, allow_device=False)
+        assert verdict == "sat"
+        aig_partition.merge_component_bits(
+            comp_dense, dense_q, np.nonzero(comp_dense.arr)[0], bits,
+            merged)
+    model = solver._reconstruct(prep, merged)  # raises on a bad merge
+    assert model is not None
+
+
+def test_router_dispatches_components_individually(monkeypatch):
+    """Component-granular dispatch: a multi-component query's sub-cones
+    reach the device backend as separate bucket units (each with its own
+    projected roots and PackedCircuit) and the merged model is returned;
+    the backend is stubbed with a CDCL oracle so no jax is paid."""
+    from mythril_tpu.tpu.backend import DeviceSolverBackend
+    from mythril_tpu.tpu.router import QueryRouter
+
+    solver, prep = _disjoint_prep()
+    monkeypatch.setenv("MYTHRIL_TPU_CALIBRATE", "0")
+
+    class OracleBackend:
+        num_restarts = 8
+        CIRCUIT_STEPS = 8
+
+        def __init__(self):
+            self.unit_log = []
+            self._pack_cache = {}
+
+        def available(self):
+            return True
+
+        def _modules(self):
+            class _J:
+                def default_backend(self):
+                    return "cpu"
+
+            return _J(), None
+
+        def count_cap_reject(self, count=1, under_floor=False):
+            pass
+
+        def pack_problem(self, problem, v1_cap):
+            num_vars, _clauses, aig_roots = problem[:3]
+            return self.pack_cone(aig_roots[0], aig_roots[1])
+
+        def pack_cone(self, aig, roots):
+            key = tuple(roots)
+            if key not in self._pack_cache:
+                self._pack_cache[key] = PackedCircuit(aig, list(roots))
+            return self._pack_cache[key]
+
+        def padded_query_slots(self, n, single_device=False):
+            return n
+
+        def try_solve_batch_circuit(self, problems, **kwargs):
+            out = []
+            for num_vars, clauses, _aig_roots in problems:
+                self.unit_log.append(num_vars)
+                status, bits = sat_backend.solve_cnf(
+                    num_vars, clauses, timeout_seconds=20.0,
+                    allow_device=False)
+                out.append(bits if status == "sat" else None)
+            return out
+
+    stats = _stats()
+    backend = OracleBackend()
+    router = QueryRouter(backend)
+    router.host_direct_levels = 0  # even tiny components take the device
+    problem = (prep.num_vars, prep.clauses, prep.aig_roots)
+    results = router.dispatch([problem], timeout_s=20.0, stats=stats)
+    assert results[0] is not None
+    assert stats.aig_device_components >= 2, \
+        "components did not ride the device path individually"
+    assert len(backend.unit_log) >= 2
+    # each dispatched unit was a sub-instance, not the monolith
+    assert all(nv < prep.num_vars for nv in backend.unit_log)
+    assert DeviceSolverBackend._honors(results[0], prep.clauses)
+    model = solver._reconstruct(prep, results[0])
+    assert model is not None
+
+
+def test_router_host_settles_oversized_components(monkeypatch):
+    """A component past the device caps settles on the host CDCL inside
+    the router while its siblings' device hits are kept — the merged
+    model still returns."""
+    from mythril_tpu.tpu.router import QueryRouter
+
+    solver, prep = _disjoint_prep()
+    monkeypatch.setenv("MYTHRIL_TPU_CALIBRATE", "0")
+    monkeypatch.setenv("MYTHRIL_TPU_LEVEL_CAP", "4")  # nothing is eligible
+    stats = _stats()
+
+    class NeverBackend:
+        num_restarts = 8
+        CIRCUIT_STEPS = 8
+
+        def __init__(self):
+            self._pack_cache = {}
+
+        def available(self):
+            return True
+
+        def _modules(self):
+            class _J:
+                def default_backend(self):
+                    return "cpu"
+
+            return _J(), None
+
+        def count_cap_reject(self, count=1, under_floor=False):
+            pass
+
+        def pack_problem(self, problem, v1_cap):
+            num_vars, _clauses, aig_roots = problem[:3]
+            return self.pack_cone(aig_roots[0], aig_roots[1])
+
+        def pack_cone(self, aig, roots):
+            key = tuple(roots)
+            if key not in self._pack_cache:
+                self._pack_cache[key] = PackedCircuit(aig, list(roots))
+            return self._pack_cache[key]
+
+        def padded_query_slots(self, n, single_device=False):
+            return n
+
+        def try_solve_batch_circuit(self, problems, **kwargs):
+            raise AssertionError("nothing is device-eligible under the cap")
+
+    router = QueryRouter(NeverBackend())
+    problem = (prep.num_vars, prep.clauses, prep.aig_roots)
+    results = router.dispatch([problem], timeout_s=20.0, stats=stats)
+    assert results[0] is not None, "host settle inside the router failed"
+    assert stats.aig_device_components == 0
+    model = solver._reconstruct(prep, results[0])
+    assert model is not None
+
+
+def test_partition_unsat_component_leaves_query_to_caller(monkeypatch):
+    """An UNSAT component must NOT produce a router verdict (the router
+    answers bits-or-None): the caller's CDCL proves the UNSAT under the
+    standard crosscheck policy."""
+    from mythril_tpu.tpu.router import QueryRouter
+
+    a = symbol_factory.BitVecSym("aigpu_a", 32)
+    c = symbol_factory.BitVecSym("aigpu_c", 32)
+    solver = Solver(timeout=20.0)
+    solver.add(a * 7 != a + 1, (a & 3) != 5)
+    solver.add(ULT(c, symbol_factory.BitVecVal(4, 32)),
+               ULT(symbol_factory.BitVecVal(9, 32), c))
+    prep = solver._prepare([])
+    if prep.trivial is not None:
+        assert prep.trivial == "unsat"
+        return
+    monkeypatch.setenv("MYTHRIL_TPU_CALIBRATE", "0")
+    monkeypatch.setenv("MYTHRIL_TPU_LEVEL_CAP", "4")
+
+    class NeverBackend:
+        num_restarts = 8
+        CIRCUIT_STEPS = 8
+
+        def __init__(self):
+            self._pack_cache = {}
+
+        def available(self):
+            return True
+
+        def _modules(self):
+            class _J:
+                def default_backend(self):
+                    return "cpu"
+
+            return _J(), None
+
+        def count_cap_reject(self, count=1, under_floor=False):
+            pass
+
+        def pack_cone(self, aig, roots):
+            key = tuple(roots)
+            if key not in self._pack_cache:
+                self._pack_cache[key] = PackedCircuit(aig, list(roots))
+            return self._pack_cache[key]
+
+        def pack_problem(self, problem, v1_cap):
+            return self.pack_cone(problem[2][0], problem[2][1])
+
+        def padded_query_slots(self, n, single_device=False):
+            return n
+
+        def try_solve_batch_circuit(self, problems, **kwargs):
+            raise AssertionError("unreachable under the level cap")
+
+    router = QueryRouter(NeverBackend())
+    results = router.dispatch(
+        [(prep.num_vars, prep.clauses, prep.aig_roots)],
+        timeout_s=20.0, stats=_stats())
+    assert results[0] is None, "router must never assert UNSAT"
+    assert solver._solve_prepared(prep) == "unsat"
+
+
+# -- PackedCircuit construct-from-subgraph (satellite) -----------------------
+
+
+def test_packed_circuit_trivially_unsat_root_sets_ok_false():
+    aig = AIG()
+    var = aig.new_var()
+    pc = PackedCircuit(aig, [FALSE_LIT])
+    assert pc.ok is False
+    # a constant-FALSE root poisons the whole set, live roots or not
+    pc = PackedCircuit(aig, [2 * var, FALSE_LIT])
+    assert pc.ok is False
+
+
+def test_packed_circuit_degenerate_one_root_cone_padded_roundtrip():
+    """A 1-root unit cone (what a pinned-input component levelizes to):
+    0 levels, one live variable, and padded_to must round-trip the root
+    tensors into any batch shape without touching live entries."""
+    import numpy as np
+
+    aig = AIG()
+    var = aig.new_var()
+    pc = PackedCircuit(aig, [2 * var + 1])  # assert NOT var
+    assert pc.ok
+    assert pc.num_levels == 0
+    assert pc.v1 == 2  # constant slot + the input
+    assert pc.num_roots == 1
+    assert pc.root_var[0] == 1 and pc.root_neg[0] == 1
+    assert pc.root_mask[0] == 1
+    padded = pc.padded_to(8, 4, 16, 8)
+    assert padded["root_var"].shape == (8,)
+    assert padded["out_idx"].shape == (8, 4)
+    assert padded["root_var"][0] == 1 and padded["root_neg"][0] == 1
+    assert padded["root_mask"][0] == 1
+    assert int(np.sum(padded["root_mask"])) == 1  # padding stays dead
+    assert int(np.sum(padded["is_gate"])) == 0
+    # vacuous-root handling on the same degenerate shape
+    pc2 = PackedCircuit(aig, [TRUE_LIT])
+    assert pc2.ok and pc2.root_mask.sum() == 0
+
+
+def test_packed_circuit_from_component_matches_direct_pack():
+    solver, prep = _disjoint_prep()
+    aig, roots, _dense = prep.aig_roots
+    partition = aig_partition.partition_cached(aig, roots)
+    assert partition is not None
+    component = next(c for c in partition.components
+                     if c.trivial_assignment is None)
+    via_classmethod = PackedCircuit.from_component(aig, component)
+    direct = PackedCircuit(aig, list(component.roots))
+    assert via_classmethod.ok and direct.ok
+    assert via_classmethod.num_levels == direct.num_levels
+    assert via_classmethod.v1 == direct.v1
+    assert list(via_classmethod.var_map) == list(direct.var_map)
+
+
+# -- findings parity (local + reference corpus) ------------------------------
+
+
+class _Args:
+    execution_timeout = 60
+    transaction_count = 2
+    max_depth = 128
+    pruning_factor = 1.0
+
+
+def _analyze_json(code_hex: str, bin_runtime: bool, tx_count: int) -> str:
+    from mythril_tpu import preanalysis
+    from mythril_tpu.core import MythrilAnalyzer, MythrilDisassembler
+    from mythril_tpu.support.model import clear_caches
+
+    clear_caches()
+    preanalysis.reset_caches()
+    aig_opt.reset_cache()
+    disassembler = MythrilDisassembler()
+    disassembler.load_from_bytecode(code_hex, bin_runtime=bin_runtime)
+    analyzer = MythrilAnalyzer(disassembler, cmd_args=_Args(),
+                               strategy="bfs")
+    report = analyzer.fire_lasers(transaction_count=tx_count)
+    return report.as_json()
+
+
+def test_findings_parity_aig_opt_on_vs_off(monkeypatch):
+    """The rewrite must be invisible in the findings: byte-identical
+    report JSON with MYTHRIL_TPU_AIG_OPT on vs off (the same contract the
+    preanalysis parity suite pins)."""
+    from tests.test_analysis import KILLBILLY
+
+    stats = _stats()
+    monkeypatch.setenv("MYTHRIL_TPU_AIG_OPT", "1")
+    on_report = _analyze_json(KILLBILLY.hex(), True, 1)
+    assert stats.aig_nodes_before > 0, "rewrite should fire during analyze"
+    assert stats.aig_nodes_after < stats.aig_nodes_before
+    monkeypatch.setenv("MYTHRIL_TPU_AIG_OPT", "0")
+    off_report = _analyze_json(KILLBILLY.hex(), True, 1)
+    assert json.loads(on_report)["issues"] == json.loads(off_report)["issues"]
+
+
+REFERENCE_INPUTS = "/root/reference/tests/testdata/inputs"
+
+
+@pytest.mark.skipif(not __import__("os").path.isdir(REFERENCE_INPUTS),
+                    reason="reference testdata not mounted")
+@pytest.mark.parametrize("file_name,tx_count,bin_runtime", [
+    ("suicide.sol.o", 1, False),
+    ("ether_send.sol.o", 2, True),
+], ids=["suicide", "ether_send"])
+def test_reference_corpus_parity_aig_on_vs_off(file_name, tx_count,
+                                               bin_runtime):
+    """Golden-corpus soundness: full analyze subprocess with the AIG
+    rewrite on vs off must produce byte-identical issue JSON."""
+    import os
+    import subprocess
+    import sys
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    outputs = []
+    for env_value, flags in (("1", ()), ("0", ("--no-aig-opt",))):
+        cmd = [sys.executable, "-m", "mythril_tpu", "analyze",
+               "-f", os.path.join(REFERENCE_INPUTS, file_name),
+               "-t", str(tx_count), "-o", "json",
+               "--solver-timeout", "60000"] + list(flags)
+        if bin_runtime:
+            cmd.append("--bin-runtime")
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env["MYTHRIL_TPU_AIG_OPT"] = env_value
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=600, cwd=repo_root, env=env)
+        assert proc.stdout.strip(), proc.stderr[-2000:]
+        outputs.append(
+            json.loads(proc.stdout.strip().splitlines()[-1])["issues"])
+    assert outputs[0] == outputs[1]

@@ -1,0 +1,37 @@
+"""Tier-1 wiring for the statistics telemetry lint
+(tools/check_stats_keys.py): every SolverStatistics counter must flow
+into the MYTHRIL_TPU_STATS_JSON emission and bench.py's ROUTING_KEYS
+roll-up — a counter nobody aggregates is evidence nobody sees."""
+
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO_ROOT, "tools"))
+
+import check_stats_keys  # noqa: E402
+
+
+def test_all_stats_counters_emitted(capsys):
+    rc = check_stats_keys.main(["check_stats_keys.py", REPO_ROOT])
+    captured = capsys.readouterr()
+    assert rc == 0, f"unemitted statistics counters:\n{captured.err}"
+
+
+def test_lint_detects_missing_bench_key(monkeypatch):
+    """The lint actually fails when a counter is missing from the bench
+    roll-up (guards against the checker matching vacuously)."""
+    from mythril_tpu.smt.solver.statistics import SolverStatistics
+
+    monkeypatch.setattr(
+        SolverStatistics, "_COUNTERS",
+        tuple(SolverStatistics._COUNTERS) + ("totally_new_counter",),
+    )
+    # the singleton predates the patch, so as_dict would also miss it —
+    # give the instance a value so only the bench check can fail... and
+    # it must.
+    monkeypatch.setattr(
+        SolverStatistics._instance, "totally_new_counter", 0,
+        raising=False)
+    rc = check_stats_keys.main(["check_stats_keys.py", REPO_ROOT])
+    assert rc == 1

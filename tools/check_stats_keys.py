@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Repo lint: every SolverStatistics counter must be emitted everywhere
+telemetry is consumed (mirrors tools/check_env_docs.py for env vars).
+
+Three invariants, each of which has silently rotted before (bench rows
+missing counters the JSON dump carried, so per-leg roll-ups under-reported
+what the run actually did):
+
+  1. every counter and timer in SolverStatistics._COUNTERS/_TIMERS appears
+     in the MYTHRIL_TPU_STATS_JSON emission (as_dict());
+  2. every counter and timer appears as a stats_key in bench.py's
+     ROUTING_KEYS roll-up (one list drives the per-leg routing row, the
+     corpus roll-up, and the summary);
+  3. every ROUTING_KEYS stats_key names a real SolverStatistics field
+     (no stale keys silently reporting 0 forever).
+
+Exits 1 listing the violations. Wired into tier-1 via
+tests/test_stats_keys.py.
+
+Usage: python tools/check_stats_keys.py [repo_root]
+"""
+
+import importlib.util
+import os
+import sys
+
+
+def _load_bench(repo_root: str):
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(repo_root, "bench.py"))
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def main(argv) -> int:
+    root = os.path.abspath(
+        argv[1] if len(argv) > 1
+        else os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+    )
+    sys.path.insert(0, root)
+    from mythril_tpu.smt.solver.statistics import SolverStatistics
+
+    bench = _load_bench(root)
+    fields = tuple(SolverStatistics._COUNTERS) + tuple(
+        SolverStatistics._TIMERS)
+    emitted = set(SolverStatistics().as_dict())
+    routed = {stats_key for stats_key, _report_key in bench.ROUTING_KEYS}
+
+    failures = []
+    missing_emit = sorted(set(fields) - emitted)
+    if missing_emit:
+        failures.append(
+            "missing from the MYTHRIL_TPU_STATS_JSON emission (as_dict): "
+            + ", ".join(missing_emit))
+    missing_bench = sorted(set(fields) - routed)
+    if missing_bench:
+        failures.append(
+            "missing from bench.py ROUTING_KEYS roll-up: "
+            + ", ".join(missing_bench))
+    known = set(fields) | {
+        name for name in dir(SolverStatistics)
+        if isinstance(getattr(SolverStatistics, name, None), property)
+    }
+    stale = sorted(routed - known)
+    if stale:
+        failures.append(
+            "bench.py ROUTING_KEYS references unknown SolverStatistics "
+            "fields: " + ", ".join(stale))
+
+    if failures:
+        print("FAIL: SolverStatistics telemetry is not fully emitted:",
+              file=sys.stderr)
+        for failure in failures:
+            print(f"  {failure}", file=sys.stderr)
+        return 1
+    print(f"ok: {len(fields)} SolverStatistics fields, all emitted in "
+          "stats JSON and the bench roll-up")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
