@@ -201,6 +201,12 @@ def add_analysis_args(parser) -> None:
                              "(strashing, constant sweeping, per-component "
                              "root projection); env override: "
                              "MYTHRIL_TPU_AIG_OPT=0|1")
+    parser.add_argument("--no-incremental-prep", action="store_true",
+                        dest="no_incremental_prep",
+                        help="disable incremental cross-query preparation "
+                             "(prefix-memoized lowering and the session "
+                             "strash table over sibling solver queries); "
+                             "env override: MYTHRIL_TPU_INCR_PREP=0|1")
     parser.add_argument("--disable-mutation-pruner", action="store_true")
     parser.add_argument("--disable-coverage-strategy", action="store_true")
     parser.add_argument("--disable-dependency-pruning", action="store_true")

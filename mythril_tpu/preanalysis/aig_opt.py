@@ -23,10 +23,15 @@ into a fresh minimized AIG:
                    one-variable contradiction the CDCL re-derives in
                    microseconds).
   strashing        the rebuild re-hashes every surviving gate through a
-                   fresh structural-hash table, so gates that became
-                   identical under the swept constants merge (the
-                   build-time strash cannot see these: the originals
-                   differed structurally when they were created).
+                   SESSION structural-hash table shared across sibling
+                   queries (_StrashSession): gates that became identical
+                   under the swept constants merge (the build-time strash
+                   cannot see these: the originals differed structurally
+                   when they were created), and gates a sibling query
+                   already swept/strashed are reused literal-for-literal
+                   instead of rebuilding against a fresh table (counted
+                   strash_xquery_merges; a per-gate rewrite memo
+                   short-circuits whole forced-constant-free sub-cones).
                    Double negations cancel on the literal encoding.
 
 Soundness: the rewrite is equisatisfiable with a recorded reconstruction
@@ -60,9 +65,97 @@ _NOT_APPLICABLE = object()
 # key: the shared blaster AIG is append-only, so a root literal's cone
 # never changes once created. Caching matters doubly here: sibling
 # analyze queries re-blast into memoized terms (same roots), and the
-# cached result's fresh AIG keeps a stable uid so the device backend's
+# cached result's session AIG keeps a stable uid so the device backend's
 # pack/pad caches keep hitting across calls.
 _cache: "OrderedDict" = OrderedDict()
+
+
+def _cache_max() -> int:
+    """Result-cache entry cap, env-overridable for long corpus runs with
+    many distinct sibling root sets (MYTHRIL_TPU_AIG_CACHE_MAX)."""
+    try:
+        return max(1, int(os.environ["MYTHRIL_TPU_AIG_CACHE_MAX"]))
+    except (KeyError, ValueError):
+        return _CACHE_MAX
+
+
+# rewrites accumulate in ONE session AIG per source AIG; past this many
+# variables the session resets (bounds memory, mirrors BLASTER_VAR_CAP)
+SESSION_VAR_CAP = 4_000_000
+
+
+class _StrashSession:
+    """Session strash/rewrite table shared across sibling queries.
+
+    Every cone rewritten from the same source AIG rebuilds into ONE
+    shared append-only session AIG, so the strash table — and a per-gate
+    rewrite memo for gates whose fanin cone carries no query-specific
+    forced constant — persist across sibling queries: a sub-cone swept
+    and strashed by query N is reused literal-for-literal by query N+1
+    (counted `strash_xquery_merges`), instead of each cone rewriting
+    against a fresh table (the PR-4 ROADMAP follow-on this closes).
+
+    Sound because the source AIG is append-only (an original var's gate
+    never changes) and `input_vars`/`clean_memo` key on original vars:
+    a memo entry is only consulted when the current query proves the
+    gate's whole fanin cone forced-constant-free (`clean` tracking in
+    optimize_roots), which is exactly the condition under which the
+    rebuild is query-independent. A new source AIG uid (term-generation
+    bump rebuilds the global blaster) or the var cap retires the session;
+    results cached against a retired session stay valid — they hold
+    their own reference to its (still append-only) AIG."""
+
+    __slots__ = ("source_uid", "aig", "input_vars", "clean_memo")
+
+    def __init__(self, source_uid):
+        self.source_uid = source_uid
+        self.aig = AIG()
+        self.aig._aig_opt_cone = True  # partition-eligible (aig_partition)
+        self.input_vars: Dict[int, int] = {}   # source var -> session var
+        self.clean_memo: Dict[int, int] = {}   # source gate var -> session lit
+
+
+_session: Optional[_StrashSession] = None
+
+
+def _get_session(aig: AIG) -> _StrashSession:
+    global _session
+    uid = getattr(aig, "uid", id(aig))
+    from mythril_tpu.smt.solver import incremental
+
+    if not incremental.enabled():
+        # cross-query sharing rides the incremental-prep switch: with the
+        # layer off every rewrite gets a private throwaway table (the
+        # pre-session per-query behavior), so the bench on/off legs
+        # isolate the whole layer
+        return _StrashSession(uid)
+    if (_session is None or _session.source_uid != uid
+            or _session.aig.num_vars > SESSION_VAR_CAP):
+        _session = _StrashSession(uid)
+    return _session
+
+
+def _cone_gate_count(aig: AIG, roots) -> int:
+    """Gates in the cone of `roots` — the session AIG holds every sibling
+    query's rewrite, so per-instance node counts must be cone-local."""
+    gate_lhs, gate_rhs = aig.gate_lhs, aig.gate_rhs
+    seen = set()
+    count = 0
+    stack = [lit >> 1 for lit in roots if (lit >> 1) != 0]
+    while stack:
+        var = stack.pop()
+        if var in seen:
+            continue
+        seen.add(var)
+        lhs = gate_lhs[var]
+        if lhs >= 0:
+            count += 1
+            if (lhs >> 1) != 0:
+                stack.append(lhs >> 1)
+            rhs = gate_rhs[var]
+            if (rhs >> 1) != 0:
+                stack.append(rhs >> 1)
+    return count
 
 
 def enabled() -> bool:
@@ -105,11 +198,13 @@ class ComposedDense:
 
 class AIGOptResult:
     __slots__ = ("aig", "roots", "input_map", "nodes_before", "nodes_after",
-                 "strash_merges", "const_folds", "trivially_unsat")
+                 "strash_merges", "const_folds", "trivially_unsat",
+                 "xquery_merges")
 
     def __init__(self, aig, roots, input_map, nodes_before, nodes_after,
-                 strash_merges, const_folds, trivially_unsat):
-        self.aig = aig                # fresh rewritten AIG (live cone only)
+                 strash_merges, const_folds, trivially_unsat,
+                 xquery_merges=0):
+        self.aig = aig                # shared session AIG (cone of roots)
         self.roots = roots            # root literals in the new numbering
         self.input_map = input_map    # orig input var -> new var
         self.nodes_before = nodes_before
@@ -117,6 +212,8 @@ class AIGOptResult:
         self.strash_merges = strash_merges
         self.const_folds = const_folds
         self.trivially_unsat = trivially_unsat
+        # gates reused from SIBLING queries via the session strash table
+        self.xquery_merges = xquery_merges
 
 
 def _trivially_unsat_result(nodes_before: int, const_folds: int,
@@ -212,28 +309,47 @@ def optimize_roots(aig: AIG, roots: List[int]) -> Optional[AIGOptResult]:
                 live_struct.add(child)
 
     # -- rebuild (forward): substitute forced constants at every use site,
-    #    re-hash surviving gates through a fresh strash table --------------
-    new_aig = AIG()
+    #    re-hash surviving gates through the SESSION strash table — gates
+    #    a sibling query already rebuilt merge instead of rebuilding, and
+    #    forced-free ("clean") sub-cones short-circuit through the
+    #    per-gate rewrite memo ----------------------------------------------
+    session = _get_session(aig)
+    new_aig = session.aig
+    session_start = new_aig.num_vars  # watermark: older vars = sibling work
     new_lit: Dict[int, int] = {0: FALSE_LIT}
     for var, value in forced.items():
         new_lit[var] = TRUE_LIT if value else FALSE_LIT
     input_map: Dict[int, int] = {}
     new_roots: List[int] = []
     strash_merges = 0
+    xquery_merges = 0
     rebuild_folds = 0
     trivially_unsat = False
+    # var -> True iff no var in its fanin cone is forced THIS query: the
+    # exact condition under which its rebuild is query-independent and the
+    # session clean_memo may serve (or store) it
+    clean: Dict[int, bool] = {}
 
     def _sub(lit: int) -> int:
         return new_lit[lit >> 1] ^ (lit & 1)
 
+    def _session_input(var: int) -> int:
+        new_var = session.input_vars.get(var)
+        if new_var is None:
+            new_var = new_aig.new_var()
+            session.input_vars[var] = new_var
+        return new_var
+
     def _rebuild_gate(var: int) -> int:
-        nonlocal strash_merges, rebuild_folds
+        nonlocal strash_merges, rebuild_folds, xquery_merges
         a, b = _sub(gate_lhs[var]), _sub(gate_rhs[var])
         before = new_aig.num_vars
         lit = new_aig.and_gate(a, b)
         if new_aig.num_vars == before:
             if lit in (TRUE_LIT, FALSE_LIT) or (lit >> 1) in (a >> 1, b >> 1):
                 rebuild_folds += 1  # collapsed by a swept constant/absorption
+            elif (lit >> 1) <= session_start:
+                xquery_merges += 1  # strash hit on a sibling query's gate
             else:
                 strash_merges += 1  # merged with an already-rebuilt gate
         return lit
@@ -244,8 +360,10 @@ def optimize_roots(aig: AIG, roots: List[int]) -> Optional[AIGOptResult]:
         if value is not None and not is_gate:
             # pinned input: keep it as a variable pinned by a unit root so
             # reconstruction (and stored-bit replay) still sees its value;
-            # its uses were substituted as structural constants above
-            new_var = new_aig.new_var()
+            # its uses were substituted as structural constants above.
+            # Session-shared: sibling queries pinning the same input (to
+            # either polarity) assert units over ONE session variable.
+            new_var = _session_input(var)
             input_map[var] = new_var
             new_roots.append(2 * new_var + (0 if value else 1))
             continue
@@ -265,20 +383,33 @@ def optimize_roots(aig: AIG, roots: List[int]) -> Optional[AIGOptResult]:
         if var not in live_struct:
             continue  # dead fanout: pruned
         if not is_gate:
-            new_var = new_aig.new_var()
+            new_var = _session_input(var)
             input_map[var] = new_var
             new_lit[var] = 2 * new_var
-        else:
-            new_lit[var] = _rebuild_gate(var)
+            clean[var] = True
+            continue
+        lhs_var, rhs_var = gate_lhs[var] >> 1, gate_rhs[var] >> 1
+        pure = (clean.get(lhs_var, lhs_var == 0)
+                and clean.get(rhs_var, rhs_var == 0))
+        if pure:
+            hit = session.clean_memo.get(var)
+            if hit is not None:
+                new_lit[var] = hit
+                clean[var] = True
+                xquery_merges += 1
+                continue
+        new_lit[var] = _rebuild_gate(var)
+        if pure:
+            clean[var] = True
+            session.clean_memo[var] = new_lit[var]
 
     const_folds = len(forced) + rebuild_folds
     if trivially_unsat:
         return _trivially_unsat_result(nodes_before, const_folds,
                                        strash_merges)
-    nodes_after = sum(
-        1 for v in range(1, new_aig.num_vars + 1) if new_aig.gate_lhs[v] >= 0)
     new_roots = list(dict.fromkeys(new_roots))
-    new_aig._aig_opt_cone = True  # marks this AIG partition-eligible
+    # cone-local count: the session AIG also holds sibling queries' cones
+    nodes_after = _cone_gate_count(new_aig, new_roots)
     unchanged = (
         nodes_after >= nodes_before
         and strash_merges == 0
@@ -299,7 +430,7 @@ def optimize_roots(aig: AIG, roots: List[int]) -> Optional[AIGOptResult]:
             return None
     return AIGOptResult(new_aig, new_roots, input_map, nodes_before,
                         nodes_after, strash_merges, const_folds,
-                        trivially_unsat=False)
+                        trivially_unsat=False, xquery_merges=xquery_merges)
 
 
 def optimize_roots_cached(aig: AIG, roots: List[int]) \
@@ -311,7 +442,8 @@ def optimize_roots_cached(aig: AIG, roots: List[int]) \
         return None if hit is _NOT_APPLICABLE else hit
     result = optimize_roots(aig, roots)
     _cache[key] = _NOT_APPLICABLE if result is None else result
-    while len(_cache) > _CACHE_MAX:
+    cache_max = _cache_max()
+    while len(_cache) > cache_max:
         _cache.popitem(last=False)
     return result
 
@@ -337,5 +469,9 @@ def evaluate_roots(aig: AIG, roots: List[int],
 
 
 def reset_cache() -> None:
-    """Testing hook."""
+    """Drop the result cache AND the session strash table (clear_caches /
+    testing hook) — stale-generation entries must never resolve against a
+    rebuilt term graph."""
+    global _session
     _cache.clear()
+    _session = None

@@ -71,11 +71,38 @@ class AIGPartition:
         self.components = components
 
 
+def _cone_vars(lhs, rhs, root_vars) -> np.ndarray:
+    """Vars in the cone of `root_vars` over the numpy gate arrays (cones
+    are bounded by aig_opt's AIG_OPT_NODE_CAP upstream)."""
+    seen = set()
+    stack = [v for v in root_vars if v]
+    while stack:
+        var = stack.pop()
+        if var in seen:
+            continue
+        seen.add(var)
+        a = int(lhs[var])
+        if a >= 0:
+            if a >> 1:
+                stack.append(a >> 1)
+            b = int(rhs[var])
+            if b >> 1:
+                stack.append(b >> 1)
+    return np.fromiter(seen, dtype=np.int64, count=len(seen))
+
+
 def partition_roots(aig: AIG, roots: List[int]) -> Optional[AIGPartition]:
     """Partition an optimized AIG's roots into variable-disjoint
     components; None when not applicable (unmarked AIG, scipy missing,
     single component, constant roots, or a pathological component
-    count)."""
+    count).
+
+    Connectivity is computed over the CONE of this root set, not the
+    whole gate table: the session strash AIG (aig_opt._StrashSession)
+    accumulates every sibling query's rewrite, so a whole-graph pass
+    would both cost O(session) per query and glue THIS query's disjoint
+    components together through foreign gates that merely share their
+    inputs."""
     if not getattr(aig, "_aig_opt_cone", False):
         return None
     root_vars = [lit >> 1 for lit in roots]
@@ -84,22 +111,28 @@ def partition_roots(aig: AIG, roots: List[int]) -> Optional[AIGPartition]:
     from mythril_tpu.preanalysis.components import connected_labels
 
     lhs, rhs = aig.gate_arrays()
-    n = aig.num_vars + 1
-    gate_vars = np.nonzero(lhs[1:n] >= 0)[0] + 1
+    cone = np.sort(_cone_vars(lhs, rhs, root_vars))
+    gate_vars = cone[lhs[cone] >= 0]
     edges_u = np.concatenate([gate_vars, gate_vars])
     edges_v = np.concatenate(
         [lhs[gate_vars] >> 1, rhs[gate_vars] >> 1])
     keep = edges_v != 0  # constant fanins do not connect components
-    labels = connected_labels(n, edges_u[keep], edges_v[keep])
+    # compact node space: every kept endpoint is a cone member (cones are
+    # closed under fanin), so searchsorted is an exact index
+    labels = connected_labels(
+        len(cone),
+        np.searchsorted(cone, edges_u[keep]),
+        np.searchsorted(cone, edges_v[keep]))
     if labels is None:
         return None
+    root_idx = np.searchsorted(cone, np.asarray(root_vars, dtype=np.int64))
     groups: Dict[int, List[int]] = {}
-    for lit, var in zip(roots, root_vars):
-        groups.setdefault(int(labels[var]), []).append(lit)
+    for lit, idx in zip(roots, root_idx):
+        groups.setdefault(int(labels[idx]), []).append(lit)
     if len(groups) < 2 or len(groups) > MAX_COMPONENTS:
         return None
 
-    is_gate = lhs[:n] >= 0
+    is_gate = lhs >= 0
     components: List[AIGComponent] = []
     for label in sorted(groups):
         comp_roots = groups[label]

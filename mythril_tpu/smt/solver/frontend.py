@@ -74,6 +74,26 @@ def _substitute(roots: List[Term], mapping: Dict[str, Term]) -> List[Term]:
     return [cache[id(r)] for r in roots]
 
 
+def _substitute_simplify_fixpoint(term: Term, mapping) -> Term:
+    """Substitute `mapping` through `term` and re-simplify until stable.
+
+    A single _substitute pass inserts replacement subtrees VERBATIM, so a
+    definition chain (x := y+1 with y := z+1, z := 3 in the same map)
+    leaves bound symbols inside the inserted rhs — the residual would
+    keep a symbol whose definition was already dropped, and model
+    reconstruction would pin it to a value the solver never saw
+    (observed: a 3-deep chain left `z` free, the solver chose z freely,
+    and validation against the original constraints raised
+    SolverInternalError). Bounded by the map size: each pass eliminates
+    at least one bound symbol or reaches the fixpoint."""
+    for _ in range(len(mapping) + 1):
+        new = terms.simplify_expr(_substitute([term], mapping)[0])
+        if new is term:
+            break
+        term = new
+    return term
+
+
 def _extract_binding(term: Term, taken) -> Optional[Tuple[str, Term]]:
     """If `term` asserts sym == rhs (or a bool unit), return the binding."""
     if term.op == "sym" and term.sort == BOOL:
@@ -118,9 +138,12 @@ def propagate_equalities(
         remaining: List[Term] = []
         for term in work:
             if found:
-                # apply this round's earlier bindings before inspecting, so
-                # `x == 5; y == x + 1` resolves in one round
-                term = terms.simplify_expr(_substitute([term], found)[0])
+                # apply this round's earlier bindings (to fixpoint — the
+                # map's values may chain) before inspecting, so
+                # `x == 5; y == x + 1` resolves in one round and a
+                # recorded rhs never references a same-round EARLIER
+                # binding (the reverse-resolution order depends on it)
+                term = _substitute_simplify_fixpoint(term, found)
             if term.is_const:
                 if term.value is False:
                     return [], substitutions, True
@@ -136,8 +159,8 @@ def propagate_equalities(
         if not found:
             return remaining, substitutions, False
         work = []
-        for term in _substitute(remaining, found):
-            term = terms.simplify_expr(term)
+        for term in remaining:
+            term = _substitute_simplify_fixpoint(term, found)
             if term.is_const:
                 if term.value is False:
                     return [], substitutions, True
@@ -234,6 +257,21 @@ class _Lowering:
         out = self.side_constraints
         self.side_constraints = []
         return out
+
+    def clone(self) -> "_Lowering":
+        """Independent copy for the incremental prefix memo: a snapshot
+        must survive this query's drain/extend, and a resumed child must
+        not mutate the shared snapshot. Side constraints are copied
+        UNDRAINED so a resume appends the suffix's constraints to the
+        prefix's and the final drain reproduces the full pipeline's root
+        order exactly."""
+        twin = _Lowering.__new__(_Lowering)
+        twin.cache = dict(self.cache)
+        twin.side_constraints = list(self.side_constraints)
+        twin.array_reads = {k: list(v) for k, v in self.array_reads.items()}
+        twin.func_apps = {k: list(v) for k, v in self.func_apps.items()}
+        twin._fresh = self._fresh
+        return twin
 
     def _lower_node(self, term: Term) -> Term:
         op = term.op
@@ -408,11 +446,31 @@ class Solver:
 
     def _prepare(self, extra: List[Term],
                  objectives: List[Term] = ()) -> "_Prepared":
-        """Simplify, lower, and blast the assertion set (+ objective bits)."""
+        """Simplify, lower, and blast the assertion set (+ objective bits).
+        Timed into prepare_wall — the prepare component of the solver-wall
+        split (host settle and device dispatch are timed at their seams)."""
+        start = time.monotonic()
+        try:
+            return self._prepare_impl(extra, objectives)
+        finally:
+            SolverStatistics().add_prepare_seconds(time.monotonic() - start)
+
+    def _prepare_impl(self, extra: List[Term],
+                      objectives: List[Term] = ()) -> "_Prepared":
+        from mythril_tpu.smt.solver import incremental
+
         prep = _Prepared()
+        # incremental cross-query preparation (smt/solver/incremental.py):
+        # memoized simplify + prefix-snapshot resume. Withheld under
+        # Optimize objectives — objectives interleave with the lowering
+        # state and the memo would have to snapshot them too for no
+        # production traffic (the engine's sibling fan-out never minimizes).
+        use_incr = not objectives and incremental.enabled()
+        simplify = (incremental.simplify_cached if use_incr
+                    else terms.simplify_expr)
         asserted: List[Term] = []
         for term in self.constraints + extra:
-            term = terms.simplify_expr(term)
+            term = simplify(term)
             if term.is_const:
                 if term.value is False:
                     prep.trivial = UNSAT
@@ -421,47 +479,74 @@ class Solver:
             asserted.append(term)
         prep.original = asserted
 
-        # pre-blast word-level preprocessing: substitute asserted
-        # definitions (sym == rhs) through the set before any lowering
-        asserted_residual, prep.substitutions, unsat = propagate_equalities(
-            asserted
-        )
-        if unsat:
+        resume = incremental.try_resume(asserted) if use_incr else None
+        if resume is not None and resume.unsat:
             prep.trivial = UNSAT
             return prep
-        # then narrow constant-bounded symbols so their high bits become
-        # structural zeros (collapses multiplier/comparison cones)
-        taken = {name for name, _ in prep.substitutions}
-        asserted_residual, narrow_subs = narrow_bounded_symbols(
-            asserted_residual, taken
-        )
-        prep.substitutions = prep.substitutions + narrow_subs
-        if asserted_residual is None:
-            prep.trivial = UNSAT
-            return prep
-        # objectives must see the same substitution; iterate because later
-        # bindings may appear inside earlier definitions
-        if objectives and prep.substitutions:
-            mapping = dict(prep.substitutions)
-            objectives = list(objectives)
-            for _ in range(len(prep.substitutions)):
-                new_objectives = [
-                    terms.simplify_expr(t)
-                    for t in _substitute(objectives, mapping)
-                ]
-                if all(a is b for a, b in zip(new_objectives, objectives)):
-                    break
-                objectives = new_objectives
+        if resume is not None:
+            # path constraints grow monotonically: this query's list is a
+            # memoized sibling's plus a suffix — the prefix's substitution
+            # map, lowering state and lowered terms are resumed and only
+            # the suffix runs the word-level pipeline below
+            asserted_residual = resume.suffix_residual
+            residual_full = resume.residual
+            prep.substitutions = resume.substitutions
+            taken_equal = resume.taken_equal
+            taken_narrow = resume.taken_narrow
+            lowering = resume.lowering
+            lowered_prefix = resume.lowered_prefix
+        else:
+            # pre-blast word-level preprocessing: substitute asserted
+            # definitions (sym == rhs) through the set before any lowering
+            asserted_residual, prep.substitutions, unsat = \
+                propagate_equalities(asserted)
+            if unsat:
+                prep.trivial = UNSAT
+                return prep
+            taken_equal = {name for name, _ in prep.substitutions}
+            # then narrow constant-bounded symbols so their high bits become
+            # structural zeros (collapses multiplier/comparison cones)
+            taken = set(taken_equal)
+            asserted_residual, narrow_subs = narrow_bounded_symbols(
+                asserted_residual, taken
+            )
+            prep.substitutions = prep.substitutions + narrow_subs
+            taken_narrow = {name for name, _ in narrow_subs}
+            if asserted_residual is None:
+                prep.trivial = UNSAT
+                return prep
+            residual_full = asserted_residual
+            # objectives must see the same substitution; iterate because
+            # later bindings may appear inside earlier definitions
+            if objectives and prep.substitutions:
+                mapping = dict(prep.substitutions)
+                objectives = list(objectives)
+                for _ in range(len(prep.substitutions)):
+                    new_objectives = [
+                        terms.simplify_expr(t)
+                        for t in _substitute(objectives, mapping)
+                    ]
+                    if all(a is b
+                           for a, b in zip(new_objectives, objectives)):
+                        break
+                    objectives = new_objectives
+            lowering = _Lowering()
+            lowered_prefix = []
 
-        lowering = _Lowering()
         try:
-            lowered = [lowering.lower(t) for t in asserted_residual]
+            lowered = lowered_prefix + [
+                lowering.lower(t) for t in asserted_residual]
             lowered_objectives = [lowering.lower(o) for o in objectives]
         except NotImplementedError:
             prep.trivial = UNKNOWN
             return prep
-        lowered += lowering.drain_side_constraints()
-        lowered = [terms.simplify_expr(t) for t in lowered]
+        if use_incr:
+            # snapshot BEFORE draining side constraints so a resumed child
+            # reproduces the full pipeline's root ordering
+            incremental.record(asserted, residual_full, prep.substitutions,
+                               taken_equal, taken_narrow, lowering, lowered)
+        lowered = lowered + lowering.drain_side_constraints()
+        lowered = [simplify(t) for t in lowered]
         if any(t.is_const and t.value is False for t in lowered):
             prep.trivial = UNSAT
             return prep
@@ -512,6 +597,9 @@ class Solver:
                         opt.nodes_before, opt.nodes_after,
                         opt.strash_merges, opt.const_folds,
                         trivial_unsat=opt.trivially_unsat)
+                    # gates reused from SIBLING queries via the session
+                    # strash table (cross-query structural sharing)
+                    stats.add_strash_xquery(opt.xquery_merges)
                     from mythril_tpu.preanalysis import aig_partition
 
                     partition = aig_partition.partition_cached(
@@ -525,11 +613,18 @@ class Solver:
             prep.aig_roots = (prep.blaster.aig,
                               list(prep.blaster.last_roots),
                               prep.var_dense)
-        prep.symbols = {
-            (name, sort)
-            for (name, sort) in terms.free_symbols(
-                list(lowered) + list(lowered_objectives))
-        }
+        if use_incr:
+            # per-root memoized scan: sibling queries share most of their
+            # constraint roots, and the full free_symbols walk re-visits
+            # the whole DAG per query otherwise
+            prep.symbols = set(incremental.free_symbols_cached(
+                list(lowered) + list(lowered_objectives)))
+        else:
+            prep.symbols = {
+                (name, sort)
+                for (name, sort) in terms.free_symbols(
+                    list(lowered) + list(lowered_objectives))
+            }
         # static CNF preprocessing (preanalysis/cnf_prep.py): unit
         # propagation + pure literals over the blasted instance BEFORE the
         # disk-tier fingerprint and router dispatch see it — variable

@@ -59,11 +59,26 @@ class SolverStatistics:
         "aig_trivial_unsat",
         "aig_components",
         "aig_device_components",
+        # incremental cross-query preparation (smt/solver/incremental.py):
+        # word-level work reused from sibling queries' prepares — memoized
+        # simplify hits, prefix-snapshot resumes (suffix-only pipelines),
+        # guarded full-pipeline fallbacks, and cross-query strash reuse in
+        # the session rewrite table (preanalysis/aig_opt.py)
+        "prepare_incremental_hits",
+        "prepare_prefix_resumes",
+        "prepare_prefix_fallbacks",
+        "prepare_suffix_terms",
+        "strash_xquery_merges",
     )
     _TIMERS = (
         "solver_time",
         "route_device_seconds",
         "route_host_seconds",
+        # solver wall attribution: prepare (simplify/lower/blast/rewrite)
+        # vs host settle (route_host_seconds) vs device dispatch
+        # (route_device_seconds) — so future rounds can see where the wall
+        # goes without re-profiling by hand
+        "prepare_wall",
     )
 
     def __new__(cls):
@@ -74,6 +89,10 @@ class SolverStatistics:
                 setattr(cls._instance, name, 0)
             for name in cls._TIMERS:
                 setattr(cls._instance, name, 0.0)
+            # suffix-length histogram of prefix resumes (not a scalar, so
+            # it lives outside _COUNTERS; reset/as_dict/absorb handle it
+            # explicitly)
+            cls._instance.prepare_suffix_hist = {}
         return cls._instance
 
     def add_query(self, seconds: float) -> None:
@@ -261,6 +280,61 @@ class SolverStatistics:
         if self.enabled:
             self.router_dispatched_clauses += clauses
 
+    def add_prepare_seconds(self, seconds: float) -> None:
+        """Wall spent inside Solver._prepare (simplify + substitution +
+        lowering + blasting + AIG rewrite + CNF preprocessing) — the
+        prepare component of the solver-wall split."""
+        if self.enabled:
+            self.prepare_wall += seconds
+
+    def add_prepare_simplify_hits(self, count: int = 1) -> None:
+        """Constraint terms whose simplification was served from the
+        cross-query simplify memo (smt/solver/incremental.py) instead of
+        a full DAG walk."""
+        if self.enabled:
+            self.prepare_incremental_hits += count
+
+    @staticmethod
+    def _suffix_bucket(suffix_terms: int) -> str:
+        if suffix_terms == 0:
+            return "0"
+        if suffix_terms == 1:
+            return "1"
+        if suffix_terms <= 4:
+            return "2-4"
+        if suffix_terms <= 16:
+            return "5-16"
+        return "17+"
+
+    def add_prefix_resume(self, suffix_terms: int) -> None:
+        """One prepare resumed from a sibling query's prefix snapshot:
+        only `suffix_terms` new constraints went through substitution /
+        lowering (0 = exact prefix match, the whole word-level phase was
+        skipped). The histogram shows the suffix-size distribution the
+        monotone path-constraint growth actually produces."""
+        if self.enabled:
+            self.prepare_prefix_resumes += 1
+            self.prepare_suffix_terms += suffix_terms
+            bucket = self._suffix_bucket(suffix_terms)
+            self.prepare_suffix_hist[bucket] = (
+                self.prepare_suffix_hist.get(bucket, 0) + 1)
+
+    def add_prefix_fallback(self) -> None:
+        """A prepare that found a prefix snapshot but had to re-run the
+        full pipeline: a suffix term introduced a new `sym == rhs`
+        definition or a narrowing bound that would substitute back
+        through the already-lowered prefix."""
+        if self.enabled:
+            self.prepare_prefix_fallbacks += 1
+
+    def add_strash_xquery(self, count: int) -> None:
+        """Gates a cone rewrite reused from SIBLING queries via the
+        session strash/rewrite table (preanalysis/aig_opt.py) — cross-
+        query structural sharing the per-query fresh-table rewrite of
+        PR 4 could not see."""
+        if self.enabled:
+            self.strash_xquery_merges += count
+
     @property
     def coalesce_occupancy(self) -> float:
         """Mean queries per coalescing-window flush (>1 means single-query
@@ -281,6 +355,7 @@ class SolverStatistics:
             setattr(self, name, 0)
         for name in self._TIMERS:
             setattr(self, name, 0.0)
+        self.prepare_suffix_hist = {}
 
     def as_dict(self) -> dict:
         """Plain-data snapshot (pickles across the --jobs worker boundary;
@@ -290,6 +365,7 @@ class SolverStatistics:
             {name: round(getattr(self, name), 4) for name in self._TIMERS})
         out["device_occupancy"] = round(self.device_occupancy, 4)
         out["coalesce_occupancy"] = round(self.coalesce_occupancy, 4)
+        out["prepare_suffix_hist"] = dict(self.prepare_suffix_hist)
         out["device"] = self.device_stats()
         return out
 
@@ -305,6 +381,10 @@ class SolverStatistics:
         for name in self._TIMERS:
             setattr(self, name, getattr(self, name)
                     + float(snapshot.get(name, 0.0)))
+        for bucket, count in (snapshot.get("prepare_suffix_hist")
+                              or {}).items():
+            self.prepare_suffix_hist[bucket] = (
+                self.prepare_suffix_hist.get(bucket, 0) + int(count))
 
     def __repr__(self):
         out = (f"Solver statistics: query count: {self.query_count}, "
@@ -345,6 +425,14 @@ class SolverStatistics:
                     f"+{self.cnf_pure_literals} pures propagated"
                     f" ({self.cnf_clauses_removed} clauses removed,"
                     f" {self.cnf_components_split} components split)")
+        if self.prepare_wall or self.prepare_prefix_resumes \
+                or self.prepare_incremental_hits:
+            out += (f", prepare: {self.prepare_wall:.2f}s wall"
+                    f" ({self.prepare_incremental_hits} simplify hits,"
+                    f" {self.prepare_prefix_resumes} prefix resumes"
+                    f"/{self.prepare_prefix_fallbacks} fallbacks,"
+                    f" {self.prepare_suffix_terms} suffix terms,"
+                    f" {self.strash_xquery_merges} cross-query strash)")
         if self.aig_nodes_before:
             out += (f", aig opt: {self.aig_nodes_before}"
                     f"->{self.aig_nodes_after} nodes"

@@ -34,6 +34,8 @@ class _Args:
         #   (MYTHRIL_TPU_PREANALYSIS=0/1 overrides; preanalysis.enabled())
         self.no_aig_opt = False                # --no-aig-opt escape hatch
         #   (MYTHRIL_TPU_AIG_OPT=0/1 overrides; preanalysis.aig_opt.enabled())
+        self.no_incremental_prep = False       # --no-incremental-prep
+        #   (MYTHRIL_TPU_INCR_PREP=0/1 overrides; smt.solver.incremental)
         self.beam_width = 8                    # --beam-search WIDTH
         self.transaction_sequences = None      # e.g. "[[0xa9059cbb],[-1]]"
         self.jobs = 1                          # corpus-parallel workers (-j)

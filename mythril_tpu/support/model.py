@@ -627,3 +627,11 @@ def clear_caches() -> None:
     from mythril_tpu.service import reset_service_state
 
     reset_service_state()
+    # incremental prepare layer: prefix snapshots and the session strash
+    # table key on term/AIG identity — stale-generation entries must never
+    # resolve against a rebuilt term graph
+    from mythril_tpu.preanalysis import aig_opt
+    from mythril_tpu.smt.solver import incremental
+
+    incremental.reset()
+    aig_opt.reset_cache()

@@ -356,6 +356,23 @@ class QueryRouter:
         cells = max(levels, 1) * max(width, 1)
         return per_cell * self._profile_steps() * 2 * cells
 
+    def prep_overhead_seconds(self) -> float:
+        """Amortized pack/pad/ship overhead per dispatch unit — the
+        backend's observed total pack+ship wall over its pack-cache
+        lookups. The cost-model term that makes dispatch eligibility
+        account for the pack-cache hit rate: a cold cache's mean is
+        dominated by full levelize+upload misses and charges against the
+        round budget, while on warm caches (sibling analyze queries
+        re-dispatch structurally identical cones) the mean decays toward
+        the cheap hit path and borderline cones become worth shipping."""
+        backend = self.backend
+        total = (getattr(backend, "pack_hits", 0)
+                 + getattr(backend, "pack_misses", 0))
+        if not total:
+            return 0.0
+        return (getattr(backend, "pack_seconds", 0.0)
+                + getattr(backend, "ship_seconds", 0.0)) / total
+
     # -- health breaker -----------------------------------------------------
 
     def device_usable(self) -> bool:
@@ -584,10 +601,13 @@ class QueryRouter:
         """THE device-admission policy, shared by monolithic queries and
         projected components so the two can never route under diverging
         rules: "cap" (past the size caps), "tiny" (host CDCL settles it
-        by propagation), "cost" (one round blows the round budget; cones
-        inside the level x cell floor are exempt — their admission is
-        the round-5 guarantee, and the dispatch deadline still bounds
-        what they may cost), or "device"."""
+        by propagation), "cost" (one round PLUS the amortized pack/ship
+        overhead blows the round budget — warm pad/pack caches shrink
+        the observed mean and make borderline cones admissible, cold
+        ones charge their measured preparation wall; cones inside the
+        level x cell floor are exempt — their admission is the round-5
+        guarantee, and the dispatch deadline still bounds what they may
+        cost), or "device"."""
         level_cap, cell_cap, v1_cap = caps
         if (pc.num_levels > level_cap
                 or pc.num_levels * pc.max_width > cell_cap
@@ -599,6 +619,7 @@ class QueryRouter:
                        and pc.num_levels * pc.max_width <= self.CELL_FLOOR)
         if (not under_floor
                 and self.est_round_seconds(pc.num_levels, pc.max_width)
+                + self.prep_overhead_seconds()
                 > self.round_budget_s):
             return "cost"
         return "device"
