@@ -211,7 +211,7 @@ class MythrilAnalyzer:
                 "enable_state_merging", "enable_summaries", "solver_backend",
                 "solve_cache", "transaction_sequences", "beam_width",
                 "disable_coverage_strategy", "jobs", "no_preanalysis",
-                "no_aig_opt", "no_incremental_prep",
+                "no_aig_opt", "no_incremental_prep", "no_vmap_frontier",
             ):
                 if hasattr(cmd_args, field) and getattr(cmd_args, field) is not None:
                     setattr(args, field, getattr(cmd_args, field))

@@ -207,6 +207,13 @@ def add_analysis_args(parser) -> None:
                              "(prefix-memoized lowering and the session "
                              "strash table over sibling solver queries); "
                              "env override: MYTHRIL_TPU_INCR_PREP=0|1")
+    parser.add_argument("--no-vmap-frontier", action="store_true",
+                        dest="no_vmap_frontier",
+                        help="disable the vmapped symbolic-execution "
+                             "frontier (batched machine states stepping "
+                             "straight-line opcode runs as one device "
+                             "step); env override: "
+                             "MYTHRIL_TPU_VMAP_FRONTIER=0|1")
     parser.add_argument("--disable-mutation-pruner", action="store_true")
     parser.add_argument("--disable-coverage-strategy", action="store_true")
     parser.add_argument("--disable-dependency-pruning", action="store_true")

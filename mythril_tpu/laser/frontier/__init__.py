@@ -1,0 +1,53 @@
+"""Vmapped symbolic-execution frontier — batched machine states and
+straight-line opcode runs as one device step (north star part (a) of the
+BASELINE: the path-exploration worklist executed as a vmapped batch).
+
+LaserEVM steps one python GlobalState at a time through term-building
+instruction handlers; once solving is cheap that loop IS the wall. This
+package packs N sibling states (same code object, same pc — the ragged
+work items) into dense padded arrays (dense.py), compiles the fork-free
+straight-line run at that pc — identified by the PR-3 CFG — into a
+micro-op program over exact 256-bit limb arithmetic (fastset.py,
+words.py), and executes the whole frontier slice in one batched step
+(kernel.py: eager numpy on host platforms, jit(vmap(...)) on
+accelerators). States whose dynamic behavior leaves the fast path
+(symbolic operands on entry, memory access beyond the dense window, gas
+exhaustion) exit the batch and replay on the existing per-state
+interpreter in laser/instructions.py — the unchanged ground-truth
+oracle. Storage ops stay on the oracle path too: SLOAD/SSTORE carry
+detector and pruner hooks in every shipped configuration, so a dense
+storage fast path would never fire (see fastset.py).
+
+Gating: `--no-vmap-frontier` CLI flag, MYTHRIL_TPU_VMAP_FRONTIER=0|1 env
+override, on top of the preanalysis master switch (the run extractor
+consumes the PR-3 CFG). Off by default for direct engine embedders;
+SymExecWrapper turns it on for analysis runs that do not require a full
+per-instruction statespace.
+"""
+
+import os
+
+from mythril_tpu.laser.frontier.stepper import FrontierStepper  # noqa: F401
+
+
+def enabled() -> bool:
+    """Env override first, then the --no-vmap-frontier flag, on top of
+    the preanalysis master switch (mirrors aig_opt.enabled())."""
+    env = os.environ.get("MYTHRIL_TPU_VMAP_FRONTIER", "")
+    if env in ("0", "off", "false"):
+        return False
+    from mythril_tpu import preanalysis
+
+    if not preanalysis.enabled():
+        return False
+    if env in ("1", "on", "true"):
+        return True
+    from mythril_tpu.support.args import args
+
+    return not getattr(args, "no_vmap_frontier", False)
+
+
+def clear_caches() -> None:
+    from mythril_tpu.laser.frontier import kernel
+
+    kernel.clear_caches()

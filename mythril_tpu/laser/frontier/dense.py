@@ -1,0 +1,181 @@
+"""Dense machine-state representation of a frontier batch.
+
+N sibling GlobalStates (same code object, same pc) densify into padded
+numpy arrays the batched kernel consumes:
+
+  stack        (N, touch, 32) int32   big-endian byte limbs of the top
+                                      `touch` stack entries the run can
+                                      read (position 0 = deepest)
+  depth        (N,)           int32   full per-state stack depth (the
+                                      untouched part below the window
+                                      stays host-side in python)
+  mem          (N, W)         int32   dense byte window of memory
+  mem_written  (N, W)         bool    kernel write mask (write-back set)
+  msize        (N,)           int32   active memory size (extension gas)
+  pc / min_gas / max_gas / gas_limit  (N,) int32
+  live         (N,)           bool    real state vs jit-shape padding
+
+Encode never mutates a state; decode commits results only for states the
+kernel finished (`ok`), writing the new stack slice (as interned constant
+terms — the same values the per-state interpreter's eager constant
+folding produces), the written memory bytes (through Memory.write_byte,
+so the SMT store chain and the concrete shadow stay in sync), msize, gas,
+and the run-end pc. States that bailed mid-run keep their original
+objects untouched and replay on the per-state interpreter.
+"""
+
+from typing import List, Optional
+
+import numpy as np
+
+from mythril_tpu.laser.frontier import words
+from mythril_tpu.laser.frontier.fastset import Run
+from mythril_tpu.laser.state.machine_state import STACK_LIMIT
+from mythril_tpu.smt import BitVec, symbol_factory
+
+# encode-side gas guard: every kernel gas quantity must stay far from the
+# int32 edge (jax under default config has no int64); runs add at most a
+# few thousand units per opcode plus window-bounded memory fees
+GAS_ENCODE_CAP = 1 << 30
+
+
+def encodable_word(entry) -> Optional[int]:
+    """Concrete, annotation-free 256-bit stack entry -> int, else None.
+    Annotations are the taint channel — a dense round-trip would drop
+    them, so tainted values keep the state on the per-state path."""
+    if not isinstance(entry, BitVec):
+        return None
+    if entry.annotations or not entry.raw.is_const:
+        return None
+    return entry.raw.value
+
+
+def state_encodable(global_state, run: Run) -> bool:
+    """Per-state batch admission for `run` (the stepper has already
+    checked engine-level and code-level conditions)."""
+    mstate = global_state.mstate
+    stack = mstate.stack
+    if len(stack) < run.touch:
+        return False  # underflow: per-state path raises the exact error
+    if len(stack) - run.touch + run.capacity > STACK_LIMIT:
+        return False  # could overflow mid-run
+    if (mstate.gas_limit > GAS_ENCODE_CAP
+            or mstate.min_gas_used > GAS_ENCODE_CAP
+            or mstate.max_gas_used > GAS_ENCODE_CAP
+            or mstate.memory.size > GAS_ENCODE_CAP):
+        return False
+    # only window slots some compute op CONSUMES must be concrete and
+    # taint-free; purely-shuffled slots pass through as opaque host
+    # values (decode reuses the original BitVec objects)
+    base = len(stack) - run.touch
+    for j in run.consumed_windows:
+        if encodable_word(stack[base + j]) is None:
+            return False
+    if run.has_mload and mstate.memory.dense_window(run.window) is None:
+        return False
+    return True
+
+
+class DenseFrontier:
+    __slots__ = ("stack", "depth", "mem", "mem_written", "msize", "pc",
+                 "min_gas", "max_gas", "gas_limit", "live")
+
+    def __init__(self, n: int, touch: int, window: int):
+        self.stack = np.zeros((n, touch, words.LIMBS), dtype=np.int32)
+        self.depth = np.zeros(n, dtype=np.int32)
+        self.mem = np.zeros((n, window), dtype=np.int32)
+        self.mem_written = np.zeros((n, window), dtype=bool)
+        self.msize = np.zeros(n, dtype=np.int32)
+        self.pc = np.zeros(n, dtype=np.int32)
+        self.min_gas = np.zeros(n, dtype=np.int32)
+        self.max_gas = np.zeros(n, dtype=np.int32)
+        self.gas_limit = np.zeros(n, dtype=np.int32)
+        self.live = np.zeros(n, dtype=bool)
+
+    @property
+    def batch(self) -> int:
+        return self.stack.shape[0]
+
+
+def encode_frontier(states: List, run: Run,
+                    pad_to: Optional[int] = None) -> DenseFrontier:
+    """Densify `states` (all pre-checked with state_encodable) for `run`,
+    padding the batch axis to `pad_to` slots (jit shape bucketing) with
+    dead copies of state 0's row shapes."""
+    n = len(states)
+    slots = max(pad_to or n, n)
+    dense = DenseFrontier(slots, run.touch, run.window)
+    for i, global_state in enumerate(states):
+        mstate = global_state.mstate
+        stack = mstate.stack
+        base = len(stack) - run.touch
+        for j in range(run.touch):
+            value = encodable_word(stack[base + j])
+            if value is None:
+                continue  # passthrough-only slot: limbs are never read
+            dense.stack[i, j] = np.frombuffer(
+                value.to_bytes(32, "big"), dtype=np.uint8)
+        dense.depth[i] = len(stack)
+        if run.has_mem:
+            window = mstate.memory.dense_window(run.window)
+            if window is not None:
+                dense.mem[i] = np.frombuffer(bytes(window), dtype=np.uint8)
+            # write-only runs on a non-densifiable memory: reads never
+            # happen, writes ride the mask — window content is irrelevant
+        dense.msize[i] = mstate.memory.size
+        dense.pc[i] = mstate.pc
+        dense.min_gas[i] = mstate.min_gas_used
+        dense.max_gas[i] = mstate.max_gas_used
+        dense.gas_limit[i] = mstate.gas_limit
+        dense.live[i] = True
+    return dense
+
+
+def decode_state(global_state, run: Run, stack_out, mem, mem_written,
+                 msize, min_gas, max_gas, i: int, mem_log=None) -> None:
+    """Commit row `i` of the kernel result into `global_state`.
+
+    Memory write-back prefers the kernel's per-store log (`mem_log`):
+    replaying each MSTORE/MSTORE8 through write_word_at/write_byte in
+    execution order rebuilds the SMT store chain byte-identically to the
+    per-state interpreter — a later symbolic-index read over the chain
+    then sees the same term structure on either path. Without a log
+    (representation-level round-trips) the write mask is applied in
+    index order instead."""
+    mstate = global_state.mstate
+    stack = mstate.stack
+    old_window = list(stack[len(stack) - run.touch:]) if run.touch else []
+    if run.touch:
+        del stack[len(stack) - run.touch:]
+    for j in range(run.out_len):
+        source = run.out_sources[j]
+        if source >= 0:
+            # passthrough slot: the SAME object the interpreter's
+            # shuffles would have left here (identity + annotations)
+            stack.append(old_window[source])
+        else:
+            stack.append(symbol_factory.BitVecVal(
+                words.int_from_limbs(stack_out[i, j]), 256))
+    if run.has_mem:
+        memory = mstate.memory
+        if mem_log is not None:
+            log_index = 0
+            for op in run.ops:
+                if op.kind == "mstore":
+                    off, value = mem_log[log_index]
+                    log_index += 1
+                    memory.write_word_at(
+                        int(off[i]), words.int_from_limbs(value[i]))
+                elif op.kind == "mstore8":
+                    off, value = mem_log[log_index]
+                    log_index += 1
+                    memory.write_byte(int(off[i]), int(value[i, 31]))
+        else:
+            for index in np.nonzero(mem_written[i])[0]:
+                memory.write_byte(int(index), int(mem[i, index]))
+        new_msize = int(msize[i])
+        if new_msize > memory.size:
+            memory._msize = new_msize
+    mstate.min_gas_used = int(min_gas[i])
+    mstate.max_gas_used = int(max_gas[i])
+    mstate.pc = run.end_pc

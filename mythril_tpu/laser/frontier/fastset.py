@@ -1,0 +1,303 @@
+"""Fast-set dispatch table and straight-line run extraction.
+
+A *run* is the longest prefix of a PR-3 CFG basic block starting at some
+pc whose every opcode the batched kernel can execute: stack shuffles
+(PUSH/DUP/SWAP/POP), add/sub/mul, bitwise ops, comparisons, shifts,
+SIGNEXTEND/BYTE, MLOAD/MSTORE/MSTORE8 on (dynamically) concrete offsets,
+and the PC/MSIZE/JUMPDEST bookkeeping ops. Runs stop before block
+terminators (the fork points), before any opcode outside the fast set,
+before any opcode with non-transparent engine hooks (detection modules,
+pruners — those must see every state individually), and before a PUSH
+with a symbolic (deploy-time-patched) operand.
+
+Deliberately OUTSIDE the fast set, with the per-state interpreter as the
+oracle: DIV/SDIV/MOD/SMOD/ADDMOD/MULMOD/EXP (bit-serial division in the
+kernel is the next promotion candidate — the interp_opcode_wall_top
+histogram measures whether it pays), SHA3/keccak (function-manager
+constraints), every environment/storage read (values are usually
+symbolic, and SLOAD/SSTORE carry detector and pruner hooks in every
+shipped configuration), and the CALL/CREATE family.
+
+Compilation statically derives the run's stack shape: `touch` (how many
+entries of the caller's stack the run can read — all must be concrete and
+annotation-free to enter a batch), `out_len` (slice length it leaves),
+and `max_height` (peak growth, for the 1024-entry overflow pre-check).
+All of this is per (code, pc), cached by the stepper — states only pay a
+dictionary hit per step.
+"""
+
+from typing import Callable, List, Optional
+
+from mythril_tpu.laser.frontier import words
+from mythril_tpu.support.opcodes import BY_NAME
+
+# shortest run worth a batch: below this the encode/decode term traffic
+# cancels the saved interpreter steps
+MIN_RUN_OPS = 3
+# dense memory window (bytes) carried per state when a run touches
+# memory; accesses past it exit the batch at run time
+MEM_WINDOW = 2048
+
+_BIN_OPS = {
+    "ADD": "add", "SUB": "sub", "MUL": "mul",
+    "AND": "and", "OR": "or", "XOR": "xor",
+    "LT": "lt", "GT": "gt", "SLT": "slt", "SGT": "sgt", "EQ": "eq",
+}
+_SHIFT_OPS = {"SHL": "shl", "SHR": "shr", "SAR": "sar"}
+_SIMPLE_OPS = frozenset(
+    ["POP", "NOT", "ISZERO", "BYTE", "SIGNEXTEND",
+     "MLOAD", "MSTORE", "MSTORE8", "MSIZE", "PC", "JUMPDEST"])
+
+
+def is_fast_op(name: str) -> bool:
+    return (
+        name in _BIN_OPS or name in _SHIFT_OPS or name in _SIMPLE_OPS
+        or name.startswith("PUSH") or name.startswith("DUP")
+        or name.startswith("SWAP")
+    )
+
+
+class MicroOp:
+    """One compiled kernel instruction: kind + static argument + the
+    opcode's static gas bounds (accrued after the op, mirroring
+    instructions.execute)."""
+
+    __slots__ = ("kind", "arg", "gas_min", "gas_max", "name")
+
+    def __init__(self, kind, arg, gas_min, gas_max, name):
+        self.kind = kind
+        self.arg = arg
+        self.gas_min = gas_min
+        self.gas_max = gas_max
+        self.name = name
+
+
+class Run:
+    """A compiled straight-line run shared by every sibling state at its
+    start pc within one code object."""
+
+    __slots__ = ("ops", "start_pc", "end_pc", "touch", "out_len",
+                 "capacity", "max_height", "has_mem", "has_mload",
+                 "window", "first_instr", "key", "op_names", "op_pcs",
+                 "consumed_windows", "out_sources")
+
+    def __init__(self, ops: List[MicroOp], start_pc: int, end_pc: int,
+                 touch: int, out_len: int, max_height: int,
+                 has_mem: bool, has_mload: bool, first_instr, key,
+                 op_pcs=(), consumed_windows=None, out_sources=None):
+        self.ops = ops
+        self.start_pc = start_pc
+        self.end_pc = end_pc
+        self.touch = touch          # entries read below the initial top
+        self.out_len = out_len      # entries the run leaves in their place
+        self.capacity = touch + max(max_height, 0)
+        self.max_height = max_height  # peak net growth above the start
+        self.has_mem = has_mem
+        self.has_mload = has_mload
+        self.window = MEM_WINDOW if has_mem else 1
+        self.first_instr = first_instr
+        self.key = key              # kernel jit-cache identity
+        self.op_names = tuple(op.name for op in ops)
+        self.op_pcs = tuple(op_pcs)  # instruction addresses of the run
+        # static slot provenance (stack shuffles are data-independent, so
+        # the flow of every original window slot through the run is known
+        # at compile time):
+        #   consumed_windows  window indices some compute op reads — ONLY
+        #                     these must be concrete and taint-free to
+        #                     enter a batch; purely-shuffled slots ride
+        #                     through as opaque host-side values
+        #   out_sources       per output slot: the original window index
+        #                     it passes through from (decode reuses the
+        #                     ORIGINAL BitVec object — identical object
+        #                     identity and annotations to an interpreter
+        #                     shuffle), or -1 for kernel-computed values
+        self.consumed_windows = (
+            frozenset(range(touch)) if consumed_windows is None
+            else frozenset(consumed_windows))
+        self.out_sources = (
+            tuple([-1] * out_len) if out_sources is None
+            else tuple(out_sources))
+
+    def __len__(self):
+        return len(self.ops)
+
+    def __repr__(self):
+        return (f"<Run pc {self.start_pc}..{self.end_pc} "
+                f"{len(self.ops)} ops touch={self.touch} "
+                f"out={self.out_len}>")
+
+
+def _compile_one(ins) -> Optional[MicroOp]:
+    """Instr -> MicroOp, or None when the instruction cannot enter a
+    batch (symbolic PUSH operand, op outside the fast set)."""
+    name = ins.opcode
+    spec = BY_NAME.get(name)
+    if spec is None:
+        return None
+    gas = (spec.gas_min, spec.gas_max)
+    if name.startswith("PUSH"):
+        value = ins.argument_int if ins.argument is not None else 0
+        if value is None:
+            return None  # deploy-time-patched symbolic operand
+        return MicroOp("push", tuple(words.word_from_int(value)), *gas,
+                       name)
+    if name.startswith("DUP"):
+        return MicroOp("dup", int(name[3:]), *gas, name)
+    if name.startswith("SWAP"):
+        return MicroOp("swap", int(name[4:]), *gas, name)
+    if name in _BIN_OPS:
+        return MicroOp("bin", _BIN_OPS[name], *gas, name)
+    if name in _SHIFT_OPS:
+        return MicroOp(_SHIFT_OPS[name], None, *gas, name)
+    if name == "POP":
+        return MicroOp("pop", None, *gas, name)
+    if name == "NOT":
+        return MicroOp("not", None, *gas, name)
+    if name == "ISZERO":
+        return MicroOp("iszero", None, *gas, name)
+    if name == "BYTE":
+        return MicroOp("byte", None, *gas, name)
+    if name == "SIGNEXTEND":
+        return MicroOp("signextend", None, *gas, name)
+    if name in ("MLOAD", "MSTORE", "MSTORE8"):
+        return MicroOp(name.lower(), None, *gas, name)
+    if name == "MSIZE":
+        return MicroOp("msize", None, *gas, name)
+    if name == "PC":
+        return MicroOp("pc", ins.address, *gas, name)
+    if name == "JUMPDEST":
+        return MicroOp("nop", None, *gas, name)
+    return None
+
+
+# micro-op kinds that CONSUME their popped operands in a computation (the
+# popped values feed limb arithmetic / memory indexing in the kernel, so
+# the originating window slots must be concrete). POP discards, DUP/SWAP
+# shuffle — their operands ride through opaquely.
+_CONSUMING_POPS = {
+    "bin": 2, "byte": 2, "shl": 2, "shr": 2, "sar": 2, "signextend": 2,
+    "not": 1, "iszero": 1, "mload": 1, "mstore": 2, "mstore8": 2,
+}
+
+
+class _Provenance:
+    """Compile-time abstract stack tracking where every slot comes from:
+    ("o", d) = the original entry d below the run-start top (1-based),
+    None = kernel-computed. Shuffles are data-independent, so this flow
+    is exact, not approximate."""
+
+    def __init__(self):
+        self.virtual = []      # entries above the untouched stack region
+        self.below = 0         # deepest original entry materialized
+        self.consumed = set()  # original depths feeding computations
+        self.max_height = 0    # peak of len(virtual) - below
+
+    def _ensure(self, needed: int) -> None:
+        while len(self.virtual) < needed:
+            self.below += 1
+            self.virtual.insert(0, ("o", self.below))
+
+    def _pop(self):
+        self._ensure(1)
+        return self.virtual.pop()
+
+    def apply(self, op: MicroOp) -> None:
+        kind = op.kind
+        consuming = _CONSUMING_POPS.get(kind, 0)
+        if consuming:
+            for _ in range(consuming):
+                item = self._pop()
+                if item is not None:
+                    self.consumed.add(item[1])
+            if BY_NAME[op.name].pushes:
+                self.virtual.append(None)
+        elif kind == "pop":
+            self._pop()
+        elif kind == "dup":
+            self._ensure(op.arg)
+            self.virtual.append(self.virtual[-op.arg])
+        elif kind == "swap":
+            self._ensure(op.arg + 1)
+            self.virtual[-1], self.virtual[-op.arg - 1] = \
+                self.virtual[-op.arg - 1], self.virtual[-1]
+        elif kind in ("push", "pc", "msize"):
+            self.virtual.append(None)
+        # "nop" (JUMPDEST): no stack effect
+        self.max_height = max(self.max_height,
+                              len(self.virtual) - self.below)
+
+
+def _instr_width(ins) -> int:
+    argument = ins.argument
+    if argument is None:
+        return 1
+    return 1 + len(argument)
+
+
+def extract_run(summary, pc: int,
+                interior_blocked: Callable[[str], bool],
+                first_post_blocked: Callable[[str], bool]) -> Optional[Run]:
+    """Compile the straight-line run starting at `pc` inside its PR-3
+    basic block, or None when no batchable run (>= MIN_RUN_OPS) starts
+    there. `interior_blocked(name)` must be True for opcodes carrying any
+    non-transparent pre/post/instr hook; the FIRST opcode may carry pre
+    hooks (the stepper fires them host-side per state) but its post hooks
+    must be transparent (`first_post_blocked`)."""
+    block = summary.cfg.block_at(pc)
+    if block is None:
+        return None
+    start_idx = None
+    for i, ins in enumerate(block.instrs):
+        if ins.address == pc:
+            start_idx = i
+            break
+    if start_idx is None:
+        return None
+
+    ops: List[MicroOp] = []
+    op_pcs: List[int] = []
+    prov = _Provenance()
+    has_mem = has_mload = False
+    end_pc = pc
+    for i in range(start_idx, len(block.instrs)):
+        ins = block.instrs[i]
+        name = ins.opcode
+        if not is_fast_op(name):
+            break
+        if i == start_idx:
+            if first_post_blocked(name):
+                return None
+        elif interior_blocked(name):
+            break
+        op = _compile_one(ins)
+        if op is None:
+            break
+        prov.apply(op)
+        if op.kind == "mload":
+            has_mem = has_mload = True
+        elif op.kind in ("mstore", "mstore8"):
+            has_mem = True
+        ops.append(op)
+        op_pcs.append(ins.address)
+        end_pc = ins.address + _instr_width(ins)
+    if len(ops) < MIN_RUN_OPS:
+        return None
+    touch = prov.below
+    return Run(
+        ops, pc, end_pc,
+        touch=touch, out_len=len(prov.virtual),
+        max_height=prov.max_height,
+        has_mem=has_mem, has_mload=has_mload,
+        first_instr=block.instrs[start_idx],
+        op_pcs=op_pcs,
+        consumed_windows=[touch - d for d in prov.consumed],
+        out_sources=[-1 if item is None else touch - item[1]
+                     for item in prov.virtual],
+        # process-unique token: the kernel's jit cache keys compiled
+        # programs by it (object ids would be unsafe — the allocator
+        # recycles them, and a stale hit would run the WRONG program)
+        key=next(_RUN_TOKENS),
+    )
+
+
+_RUN_TOKENS = iter(range(1, 1 << 62))

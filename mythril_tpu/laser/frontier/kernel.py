@@ -1,0 +1,341 @@
+"""Batched straight-line step kernel.
+
+One compiled Run executes over a whole DenseFrontier in a single step.
+The micro-op interpreter `_exec` is written once against axis-agnostic
+word ops (frontier/words.py) plus a tiny backend shim for the two
+operations whose indexing genuinely differs per backend (dynamic memory
+gather/scatter):
+
+  numpy   eager, batch axis explicit — every stack slot is (N, 32), the
+          memory window (N, W). No compile step: the right default on
+          host-CPU platforms where an XLA compile per (run, shape) would
+          eat the win.
+  jax     the kernel is written single-state — stack slots (32,), memory
+          (W,) — and `jax.jit(jax.vmap(...))` lifts it over the batch
+          axis. Batches are padded to power-of-two slots so the compile
+          cache is bounded per run; padding rides the `live` mask and is
+          discarded on decode.
+
+Because sibling states share their pc, the whole batch executes the SAME
+opcode sequence — the program is a trace-time python loop, and the only
+per-state control flow is the `ok` mask: a state whose dynamic behavior
+leaves the fast path (memory access outside the dense window, gas
+exhaustion) has its row frozen out and replays, untouched, on the
+per-state interpreter. Stack shape is static per program point, so the
+working stack is a python list of per-slot arrays — the padded dense
+array exists only at the encode/decode boundary.
+
+Backend choice: MYTHRIL_TPU_FRONTIER_BACKEND=numpy|jax|auto (default
+auto = jax only when jax is already loaded AND its default platform is a
+real accelerator — the TVM lesson: compile the common case where compile
+time amortizes, interpret everywhere else).
+"""
+
+import os
+from typing import Optional
+
+import numpy as np
+
+from mythril_tpu.laser.frontier import words
+from mythril_tpu.laser.frontier.dense import DenseFrontier
+from mythril_tpu.laser.frontier.fastset import Run
+
+_JIT_CACHE = {}
+_JIT_CACHE_MAX = 512
+
+
+def resolve_backend() -> str:
+    choice = os.environ.get("MYTHRIL_TPU_FRONTIER_BACKEND", "auto").lower()
+    if choice in ("numpy", "jax"):
+        return choice
+    import sys
+
+    if "jax" in sys.modules:
+        try:
+            if sys.modules["jax"].default_backend() != "cpu":
+                return "jax"
+        except Exception:
+            pass
+    return "numpy"
+
+
+# -- binary op table ---------------------------------------------------------
+
+
+def _lt(xp, a, b):
+    return words.mask_to_word(xp, words.ult_mask(xp, a, b))
+
+
+def _gt(xp, a, b):
+    return words.mask_to_word(xp, words.ult_mask(xp, b, a))
+
+
+def _slt(xp, a, b):
+    return words.mask_to_word(xp, words.slt_mask(xp, a, b))
+
+
+def _sgt(xp, a, b):
+    return words.mask_to_word(xp, words.slt_mask(xp, b, a))
+
+
+def _eq(xp, a, b):
+    return words.mask_to_word(xp, words.eq_mask(xp, a, b))
+
+
+_BIN_FNS = {
+    "add": words.add, "sub": words.sub, "mul": words.mul,
+    "and": words.bit_and, "or": words.bit_or, "xor": words.bit_xor,
+    "lt": _lt, "gt": _gt, "slt": _slt, "sgt": _sgt, "eq": _eq,
+}
+
+
+# -- backends ----------------------------------------------------------------
+
+
+class _NumpyBackend:
+    def __init__(self, batch: int):
+        self.xp = np
+        self.batch = batch
+        self._offsets32 = np.arange(32)
+
+    def const_word(self, limbs):
+        return np.broadcast_to(
+            np.array(limbs, dtype=np.int32), (self.batch, words.LIMBS))
+
+    def gather_word(self, mem, off):
+        idx = off[:, None] + self._offsets32
+        return np.take_along_axis(mem, idx, axis=1)
+
+    def scatter(self, mem, written, off, value, ok, size):
+        idx = off[:, None] + np.arange(size)
+        value = np.broadcast_to(value, idx.shape)
+        current = np.take_along_axis(mem, idx, axis=1)
+        np.put_along_axis(
+            mem, idx, np.where(ok[:, None], value, current), axis=1)
+        current_w = np.take_along_axis(written, idx, axis=1)
+        np.put_along_axis(written, idx, current_w | ok[:, None], axis=1)
+        return mem, written
+
+
+class _JaxBackend:
+    """Single-state semantics; jax.vmap supplies the batch axis."""
+
+    def __init__(self, jax_mod):
+        self.jax = jax_mod
+        self.xp = jax_mod.numpy
+
+    def const_word(self, limbs):
+        return self.xp.array(limbs, dtype=self.xp.int32)
+
+    def gather_word(self, mem, off):
+        return self.jax.lax.dynamic_slice(mem, (off,), (32,))
+
+    def scatter(self, mem, written, off, value, ok, size):
+        lax = self.jax.lax
+        value = self.xp.broadcast_to(value, (size,))
+        current = lax.dynamic_slice(mem, (off,), (size,))
+        mem = lax.dynamic_update_slice(
+            mem, self.xp.where(ok, value, current), (off,))
+        current_w = lax.dynamic_slice(written, (off,), (size,))
+        written = lax.dynamic_update_slice(written, current_w | ok, (off,))
+        return mem, written
+
+
+# -- the micro-op interpreter ------------------------------------------------
+
+
+def _mem_extend(xp, off, size, msize, min_gas, max_gas, gas_limit, ok):
+    """Bit-exact mirror of MachineState.mem_extend for concrete offsets:
+    word-aligned growth + the yellow-paper quadratic fee + check_gas."""
+    from mythril_tpu.laser.state.machine_state import memory_expansion_fee
+
+    end = off + size
+    needed = ((end + 31) // 32) * 32
+    new_words = needed // 32
+    old_words = msize // 32
+    extend = (msize <= end) & (new_words > old_words)
+    # quadratic terms only evaluated on the extending lane (a dead lane's
+    # msize may sit anywhere below the int32 encode cap — its square must
+    # never be computed)
+    ow = xp.where(extend, old_words, 0)
+    nw = xp.where(extend, new_words, 0)
+    fee = memory_expansion_fee(nw) - memory_expansion_fee(ow)
+    min_gas = min_gas + fee
+    max_gas = max_gas + fee
+    ok = ok & (min_gas <= gas_limit)
+    msize = xp.where(extend, needed, msize)
+    return msize, min_gas, max_gas, ok
+
+
+def _exec(bk, run: Run, slots, mem, written, msize, min_gas, max_gas,
+          gas_limit, ok):
+    # (offset, value-word) per MSTORE/MSTORE8 in run order: decode
+    # replays these through Memory.write_word_at/write_byte so the SMT
+    # store chain is built in EXECUTION order with the exact values —
+    # byte-identical to the per-state interpreter's chain (a later
+    # symbolic-index read over the chain sees the same term structure)
+    mem_log = []
+    xp = bk.xp
+    for op in run.ops:
+        kind = op.kind
+        if kind == "push":
+            slots.append(bk.const_word(op.arg))
+        elif kind == "dup":
+            slots.append(slots[-op.arg])
+        elif kind == "swap":
+            n = op.arg
+            slots[-1], slots[-n - 1] = slots[-n - 1], slots[-1]
+        elif kind == "pop":
+            slots.pop()
+        elif kind == "bin":
+            a = slots.pop()
+            b = slots.pop()
+            slots.append(_BIN_FNS[op.arg](xp, a, b))
+        elif kind == "not":
+            slots.append(words.bit_not(xp, slots.pop()))
+        elif kind == "iszero":
+            slots.append(
+                words.mask_to_word(xp, words.is_zero_mask(xp, slots.pop())))
+        elif kind == "byte":
+            index = slots.pop()
+            value = slots.pop()
+            slots.append(words.byte_op(xp, index, value))
+        elif kind in ("shl", "shr", "sar"):
+            shift = slots.pop()
+            value = slots.pop()
+            slots.append(getattr(words, kind)(xp, shift, value))
+        elif kind == "signextend":
+            position = slots.pop()
+            value = slots.pop()
+            slots.append(words.signextend(xp, position, value))
+        elif kind == "mload":
+            off, oob = words.mem_offset(
+                xp, slots.pop(), 32, run.window)
+            ok = ok & ~oob
+            msize, min_gas, max_gas, ok = _mem_extend(
+                xp, off, 32, msize, min_gas, max_gas, gas_limit, ok)
+            slots.append(bk.gather_word(mem, off))
+        elif kind == "mstore":
+            off, oob = words.mem_offset(
+                xp, slots.pop(), 32, run.window)
+            value = slots.pop()
+            ok = ok & ~oob
+            msize, min_gas, max_gas, ok = _mem_extend(
+                xp, off, 32, msize, min_gas, max_gas, gas_limit, ok)
+            mem, written = bk.scatter(mem, written, off, value, ok, 32)
+            mem_log.append((off, value))
+        elif kind == "mstore8":
+            off, oob = words.mem_offset(
+                xp, slots.pop(), 1, run.window)
+            value = slots.pop()
+            ok = ok & ~oob
+            msize, min_gas, max_gas, ok = _mem_extend(
+                xp, off, 1, msize, min_gas, max_gas, gas_limit, ok)
+            mem, written = bk.scatter(
+                mem, written, off, value[..., 31:], ok, 1)
+            mem_log.append((off, value))
+        elif kind == "msize":
+            slots.append(words.small_to_word(xp, msize))
+        elif kind == "pc":
+            slots.append(bk.const_word(words.word_from_int(op.arg)))
+        elif kind == "nop":
+            pass
+        else:  # pragma: no cover - compile and execute must stay in sync
+            raise AssertionError(f"unknown micro-op {kind}")
+        # opcode gas accrues after the handler, as in instructions.execute
+        min_gas = min_gas + op.gas_min
+        max_gas = max_gas + op.gas_max
+        ok = ok & (min_gas <= gas_limit)
+    return slots, mem, written, msize, min_gas, max_gas, ok, mem_log
+
+
+# -- entry points ------------------------------------------------------------
+
+
+def _step_numpy(run: Run, dense: DenseFrontier):
+    batch = dense.batch
+    bk = _NumpyBackend(batch)
+    slots = [dense.stack[:, j] for j in range(run.touch)]
+    slots, mem, written, msize, min_gas, max_gas, ok, mem_log = _exec(
+        bk, run, slots, dense.mem, dense.mem_written, dense.msize,
+        dense.min_gas, dense.max_gas, dense.gas_limit, dense.live.copy())
+    if slots:
+        stack_out = np.stack(
+            [np.broadcast_to(s, (batch, words.LIMBS)) for s in slots],
+            axis=1)
+    else:
+        stack_out = np.zeros((batch, 0, words.LIMBS), dtype=np.int32)
+    mem_log = [
+        (np.broadcast_to(off, (batch,)),
+         np.broadcast_to(value, (batch, words.LIMBS)))
+        for off, value in mem_log
+    ]
+    return stack_out, mem, written, msize, min_gas, max_gas, ok, mem_log
+
+
+def _build_jax_step(run: Run):
+    import jax
+
+    bk = _JaxBackend(jax)
+    jnp = jax.numpy
+
+    def single(stack, mem, written, msize, min_gas, max_gas, gas_limit,
+               live):
+        slots = [stack[j] for j in range(run.touch)]
+        slots, mem, written, msize, min_gas, max_gas, ok, mem_log = _exec(
+            bk, run, slots, mem, written, msize, min_gas, max_gas,
+            gas_limit, live)
+        if slots:
+            stack_out = jnp.stack(
+                [jnp.broadcast_to(s, (words.LIMBS,)) for s in slots])
+        else:
+            stack_out = jnp.zeros((0, words.LIMBS), dtype=jnp.int32)
+        flat_log = []
+        for off, value in mem_log:
+            flat_log.append(jnp.broadcast_to(off, ()))
+            flat_log.append(jnp.broadcast_to(value, (words.LIMBS,)))
+        return (stack_out, mem, written, msize, min_gas, max_gas, ok,
+                *flat_log)
+
+    return jax.jit(jax.vmap(single))
+
+
+def _step_jax(run: Run, dense: DenseFrontier):
+    key = (run.key, dense.batch)
+    step = _JIT_CACHE.get(key)
+    if step is None:
+        if len(_JIT_CACHE) >= _JIT_CACHE_MAX:
+            _JIT_CACHE.clear()
+        step = _build_jax_step(run)
+        _JIT_CACHE[key] = step
+    out = step(dense.stack, dense.mem, dense.mem_written, dense.msize,
+               dense.min_gas, dense.max_gas, dense.gas_limit, dense.live)
+    out = [np.asarray(part) for part in out]
+    flat_log = out[7:]
+    mem_log = [(flat_log[i], flat_log[i + 1])
+               for i in range(0, len(flat_log), 2)]
+    return (*out[:7], mem_log)
+
+
+def pad_slots(n: int) -> int:
+    """Power-of-two jit shape bucket (bounds compile variants per run)."""
+    slots = 1
+    while slots < n:
+        slots *= 2
+    return slots
+
+
+def step_batch(run: Run, dense: DenseFrontier,
+               backend: Optional[str] = None):
+    """Execute `run` over the dense batch. Returns (stack_out, mem,
+    mem_written, msize, min_gas, max_gas, ok, mem_log) as numpy arrays;
+    mem_log holds one (offset, value-word) pair per MSTORE/MSTORE8 of the
+    run, in execution order. Rows with ok=False (bailed or padding) must
+    be discarded by the caller."""
+    if (backend or resolve_backend()) == "jax":
+        return _step_jax(run, dense)
+    return _step_numpy(run, dense)
+
+
+def clear_caches() -> None:
+    _JIT_CACHE.clear()

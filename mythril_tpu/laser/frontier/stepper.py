@@ -1,0 +1,315 @@
+"""FrontierStepper — the engine side of the vmapped frontier.
+
+Sits in LaserEVM.exec between the strategy and execute_state. For each
+state the strategy yields, it tries to execute the straight-line run at
+the state's pc as ONE batched device step over every eligible sibling
+(same code object, same pc) it can pull from the worklist. Everything the
+per-state path would have done for those opcodes is either replicated
+exactly (stack/memory/pc/gas, in the kernel), fired host-side once per
+state (execute_state laser hooks and the first opcode's pre hooks —
+eligibility requires every such hook to opt in), or provably a no-op for
+straight-line fast-set runs (manage_cfg, fork pruning, depth accounting).
+
+Hook contract (opt-in via function attributes):
+  frontier_once_ok    an execute_state laser hook whose effect is
+                      equivalent when fired once per run instead of once
+                      per instruction (its condition only reads run-
+                      invariant state, e.g. the transaction stack) —
+                      fired host-side per state at run start.
+  frontier_batch      optional companion: called once per successful
+                      batched run with (completed_states, run) to replay
+                      per-instruction accounting batch-wise (coverage
+                      marks the whole run's pcs).
+  frontier_transparent  a pre/post/instr hook that is purely
+                      observational per-instruction telemetry and may be
+                      skipped for batched runs (the instruction
+                      profiler; the interp_opcode_wall_top histogram
+                      covers the fallback path it still profiles).
+
+Any unmarked execute_state hook disables the stepper for the whole
+engine; any unmarked pre/post/instr hook on an opcode cuts runs before
+that opcode — detection modules and pruners always see their states
+individually.
+"""
+
+import logging
+from typing import List, Optional
+
+from mythril_tpu.laser.frontier import dense, fastset, kernel
+from mythril_tpu.laser.plugin.signals import PluginSkipState
+
+log = logging.getLogger(__name__)
+
+# cap on sibling states per batched step (bounds encode latency and the
+# jit shape buckets; BFS worklists happily exceed this on dispatch fans)
+MAX_BATCH = 64
+
+_MISS = object()
+
+
+def _span_skipped(state, pc: int) -> bool:
+    """True while `state` sits inside the run span it last exited (bailed
+    mid-batch or failed encoding): the per-state interpreter owns it from
+    the run start through end_pc (re-batching at every interior pc would
+    cost O(run length) kernel launches per bail). The flag clears itself
+    the first time the state is seen OUTSIDE the span, so a later
+    loop-back into the same run — where the bail cause may no longer
+    hold — batches again."""
+    span = getattr(state, "_frontier_skip_span", None)
+    if span is None:
+        return False
+    if span[0] <= pc < span[1]:
+        return True
+    state._frontier_skip_span = None
+    return False
+
+
+class FrontierStepper:
+    def __init__(self, svm):
+        self.svm = svm
+        self.backend = kernel.resolve_backend()
+        self._runs = {}          # (bytecode_hash, pc) -> Run | None
+        self._blocked = {}       # opcode name -> interior-blocked bool
+        self._engine_ok: Optional[bool] = None
+        log.debug("frontier stepper ready (backend=%s)", self.backend)
+
+    # -- engine / hook gates -------------------------------------------------
+
+    def _check_engine(self) -> bool:
+        """All execute_state laser hooks must be frontier-aware; checked
+        once (hooks are registered before sym_exec starts)."""
+        if self._engine_ok is None:
+            self._engine_ok = all(
+                getattr(hook, "frontier_once_ok", False)
+                for hook in self.svm._hooks["execute_state"]
+            )
+            if not self._engine_ok:
+                log.debug("frontier disabled: unmarked execute_state hook")
+        return self._engine_ok
+
+    def _hook_entries(self, tables, name):
+        for table in tables:
+            entries = table.get(name)
+            if entries:
+                for hook in entries:
+                    yield hook
+
+    def _interior_blocked(self, name: str) -> bool:
+        cached = self._blocked.get(name)
+        if cached is None:
+            svm = self.svm
+            cached = any(
+                not getattr(hook, "frontier_transparent", False)
+                for hook in self._hook_entries(
+                    (svm.pre_hooks, svm.post_hooks,
+                     svm.instr_pre_hook, svm.instr_post_hook), name)
+            )
+            self._blocked[name] = cached
+        return cached
+
+    def _first_post_blocked(self, name: str) -> bool:
+        svm = self.svm
+        return any(
+            not getattr(hook, "frontier_transparent", False)
+            for hook in self._hook_entries(
+                (svm.post_hooks, svm.instr_post_hook), name)
+        )
+
+    # -- run cache -----------------------------------------------------------
+
+    def _run_for(self, code, pc: int) -> Optional[fastset.Run]:
+        key = (code.bytecode_hash, pc)
+        cached = self._runs.get(key, _MISS)
+        if cached is not _MISS:
+            return cached
+        run = None
+        # cheap peek before paying full extraction: most pcs are visited
+        # once per leg (the cache rarely amortizes), and most fail
+        # because fewer than MIN_RUN_OPS fast opcodes follow — a set
+        # probe over the next few instruction names settles that at a
+        # fraction of the compile cost
+        if self._peek_fast(code, pc):
+            from mythril_tpu import preanalysis
+
+            summary = preanalysis.get_code_summary(code)
+            if summary is not None:
+                run = fastset.extract_run(
+                    summary, pc, self._interior_blocked,
+                    self._first_post_blocked)
+        self._runs[key] = run
+        return run
+
+    @staticmethod
+    def _peek_fast(code, pc: int) -> bool:
+        index = code.index_of_address(pc)
+        if index is None:
+            return False
+        instrs = code.instruction_list
+        if index + fastset.MIN_RUN_OPS > len(instrs):
+            return False
+        return all(
+            fastset.is_fast_op(instrs[index + k].opcode)
+            for k in range(fastset.MIN_RUN_OPS)
+        )
+
+    # -- sibling scheduling --------------------------------------------------
+
+    def _loop_vetter(self):
+        """The bounded-loops wrapper's per-yield accounting, if present in
+        the strategy chain — sibling states bypass strategy.__next__, so
+        the stepper must apply the same vetting or loops run unbounded."""
+        strategy = self.svm.strategy
+        while strategy is not None:
+            vet = getattr(strategy, "vet_state", None)
+            if vet is not None:
+                return vet
+            strategy = getattr(strategy, "super_strategy", None)
+        return None
+
+    def _collect_siblings(self, lead, run) -> List:
+        svm = self.svm
+        # bytecode-hash equality, not object identity: sibling states of
+        # one contract share the Disassembly, but separately-loaded equal
+        # code executes identically and batches just as well
+        code_hash = lead.environment.code.bytecode_hash
+        pc = lead.mstate.pc
+        vet = self._loop_vetter()
+        batch = [lead]
+        kept = []
+        taken = 0
+        for state in svm.work_list:
+            if (taken < MAX_BATCH - 1
+                    and state.mstate.pc == pc
+                    and state.environment.code.bytecode_hash == code_hash
+                    and state.mstate.depth < svm.max_depth
+                    and not _span_skipped(state, pc)
+                    and dense.state_encodable(state, run)):
+                if vet is not None and not vet(state):
+                    # loop bound exceeded: dropped exactly as the
+                    # strategy's own filter would have dropped it
+                    taken += 1
+                    continue
+                batch.append(state)
+                taken += 1
+            else:
+                kept.append(state)
+        if taken:
+            svm.work_list[:] = kept
+        return batch
+
+    def _retract_loop_visit(self, state, run) -> None:
+        """A bailed state will be re-yielded at the SAME pc and vetted by
+        the bounded-loops wrapper again — but its JUMPDEST trace entry
+        for this visit was already appended (by the strategy yield for
+        the lead, by _collect_siblings' vetting for siblings). Pop it so
+        one real visit counts once, or loop bounds would trip at half the
+        true iteration count on repeatedly-bailing runs."""
+        if run.first_instr.opcode != "JUMPDEST" \
+                or self._loop_vetter() is None:
+            return
+        from mythril_tpu.laser.strategy.extensions.bounded_loops import (
+            JumpdestCountAnnotation,
+        )
+
+        for annotation in state.annotations:
+            if isinstance(annotation, JumpdestCountAnnotation):
+                if annotation.trace and annotation.trace[-1] == \
+                        run.start_pc:
+                    annotation.trace.pop()
+                return
+
+    # -- the batched step ----------------------------------------------------
+
+    def try_step(self, lead) -> Optional[List]:
+        """Batched-step the run at `lead`'s pc. Returns the successor
+        list (completed states at the run-end pc + bailed states,
+        untouched, flagged to replay per-state), or None when the normal
+        per-state path must handle `lead`."""
+        if not self._check_engine():
+            return None
+        pc = lead.mstate.pc
+        if _span_skipped(lead, pc):
+            return None
+        # a pc past the code end (implicit STOP) has no instruction index
+        # and falls out of _run_for's peek — the per-state path owns it
+        run = self._run_for(lead.environment.code, pc)
+        if run is None:
+            return None
+        if not dense.state_encodable(lead, run):
+            lead._frontier_skip_span = (run.start_pc, run.end_pc)
+            return None
+        svm = self.svm
+        batch = self._collect_siblings(lead, run)
+
+        # host-side per-state prologue: execute_state hooks (all
+        # frontier_once_ok), the run-start statespace snapshot, and the
+        # first opcode's non-transparent pre hooks
+        first_name = run.first_instr.opcode
+        first_pre = [
+            hook for hook in self._hook_entries(
+                (svm.pre_hooks, svm.instr_pre_hook), first_name)
+            if not getattr(hook, "frontier_transparent", False)
+        ]
+        survivors = []
+        snapshots = {}
+        for state in batch:
+            try:
+                for hook in svm._hooks["execute_state"]:
+                    hook(state)
+            except PluginSkipState:
+                continue
+            if svm.requires_statespace and state.node is not None:
+                # capture the run-start snapshot NOW (it must show the
+                # pre-run stack) but commit it only if the state
+                # completes the batch — a bailed state re-records when
+                # it replays per-state, and committing both would
+                # duplicate the snapshot
+                from mythril_tpu.laser.svm import _StateSnapshot
+
+                snapshots[id(state)] = (
+                    state.node, _StateSnapshot(state, run.first_instr))
+            try:
+                for hook in first_pre:
+                    hook(state)
+            except PluginSkipState:
+                continue
+            survivors.append(state)
+        if not survivors:
+            return []
+
+        pad = (kernel.pad_slots(len(survivors))
+               if self.backend == "jax" else len(survivors))
+        frame = dense.encode_frontier(survivors, run, pad_to=pad)
+        stack_out, mem, written, msize, min_gas, max_gas, ok, mem_log = \
+            kernel.step_batch(run, frame, self.backend)
+
+        results = []
+        completed = []
+        for i, state in enumerate(survivors):
+            if ok[i]:
+                dense.decode_state(state, run, stack_out, mem, written,
+                                   msize, min_gas, max_gas, i,
+                                   mem_log=mem_log)
+                snapshot = snapshots.get(id(state))
+                if snapshot is not None:
+                    snapshot[0].states.append(snapshot[1])
+                completed.append(state)
+            else:
+                # replay the WHOLE run on the per-state interpreter from
+                # the untouched original state; the span flag keeps every
+                # pc of this run off the batch path for it
+                state._frontier_skip_span = (run.start_pc, run.end_pc)
+                self._retract_loop_visit(state, run)
+            results.append(state)
+
+        from mythril_tpu.smt.solver.statistics import SolverStatistics
+
+        SolverStatistics().add_frontier_step(
+            states=len(completed), slots=pad,
+            fallback_exits=len(survivors) - len(completed))
+        if completed:
+            for hook in svm._hooks["execute_state"]:
+                replay = getattr(hook, "frontier_batch", None)
+                if replay is not None:
+                    replay(completed, run)
+        return results

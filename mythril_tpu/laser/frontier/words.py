@@ -1,0 +1,249 @@
+"""Batched 256-bit machine words as big-endian uint8 limb arrays.
+
+The dense frontier representation keeps every EVM word as 32 limbs of one
+byte each (big-endian, limb 0 = most significant), stored in int32 arrays
+whose LAST axis is the limb axis — leading axes are free, so the same op
+code runs single-state (shape ``(32,)``, the form `jax.vmap` maps over)
+and batched (shape ``(N, 32)``, the numpy eager path). Byte limbs were
+chosen over wider packings deliberately:
+
+  - they match EVM memory bytes exactly, so MLOAD/MSTORE are pure
+    gathers/scatters with no repacking at the memory seam;
+  - partial products in MUL fit comfortably in int32 (32 * 255^2 < 2^21),
+    so no backend needs 64-bit intermediates — jax under the default
+    x64-disabled config has no int64;
+  - carry/borrow propagation is a statically-unrolled 32-step pass.
+
+Every function takes the array namespace `xp` (numpy or jax.numpy)
+explicitly; nothing here imports jax. All ops are exact bit-level
+implementations of the corresponding EVM semantics — the differential
+property tests in tests/test_frontier.py hold them to the per-state
+interpreter bit for bit.
+"""
+
+LIMBS = 32
+WORD_BITS = 256
+
+
+# -- host-side packing (python int <-> limb vectors) -------------------------
+
+
+def word_from_int(value: int):
+    """256-bit python int -> list of 32 big-endian byte limbs."""
+    return list(value.to_bytes(32, "big"))
+
+
+def int_from_limbs(limbs) -> int:
+    """Limb vector (any int array-like of length 32) -> python int."""
+    return int.from_bytes(bytes(int(v) & 0xFF for v in limbs), "big")
+
+
+# -- canonicalization --------------------------------------------------------
+
+
+def _carry_canon(xp, cols):
+    """Propagate carries LSB->MSB over raw column sums (each column may
+    hold any value < 2^31 / 32); the final carry out of limb 0 is dropped
+    (mod 2^256)."""
+    cols = list(cols)
+    for i in range(LIMBS - 1, 0, -1):
+        carry = cols[i] >> 8
+        cols[i] = cols[i] & 0xFF
+        cols[i - 1] = cols[i - 1] + carry
+    cols[0] = cols[0] & 0xFF
+    return xp.stack(cols, axis=-1)
+
+
+# -- arithmetic --------------------------------------------------------------
+
+
+def add(xp, a, b):
+    return _carry_canon(xp, [a[..., i] + b[..., i] for i in range(LIMBS)])
+
+
+def sub(xp, a, b):
+    cols = [a[..., i] - b[..., i] for i in range(LIMBS)]
+    for i in range(LIMBS - 1, 0, -1):
+        borrow = (cols[i] < 0).astype(a.dtype)
+        cols[i] = cols[i] + (borrow << 8)
+        cols[i - 1] = cols[i - 1] - borrow
+    cols[0] = cols[0] & 0xFF
+    return xp.stack(cols, axis=-1)
+
+
+def mul(xp, a, b):
+    """Truncated 256-bit product. Column k (byte weight 31-k) collects the
+    partial products with i + j = 31 + k."""
+    zero = a[..., 0] * 0
+    cols = [zero] * LIMBS
+    for i in range(LIMBS):
+        ai = a[..., i]
+        for j in range(LIMBS - 1 - i, LIMBS):
+            k = i + j - (LIMBS - 1)
+            cols[k] = cols[k] + ai * b[..., j]
+    return _carry_canon(xp, cols)
+
+
+# -- comparisons (return bool masks over the leading axes) -------------------
+
+
+def eq_mask(xp, a, b):
+    return xp.all(a == b, axis=-1)
+
+
+def is_zero_mask(xp, a):
+    return xp.all(a == 0, axis=-1)
+
+
+def ult_mask(xp, a, b):
+    """Unsigned a < b: lexicographic from the most significant limb."""
+    result = xp.zeros(a.shape[:-1], dtype=bool)
+    decided = xp.zeros(a.shape[:-1], dtype=bool)
+    for i in range(LIMBS):
+        ai, bi = a[..., i], b[..., i]
+        result = xp.where(~decided & (ai < bi), True, result)
+        decided = decided | (ai != bi)
+    return result
+
+
+def _flip_sign(xp, a):
+    """XOR the sign bit so signed compare = unsigned compare of images."""
+    return xp.concatenate([a[..., :1] ^ 0x80, a[..., 1:]], axis=-1)
+
+
+def slt_mask(xp, a, b):
+    return ult_mask(xp, _flip_sign(xp, a), _flip_sign(xp, b))
+
+
+def mask_to_word(xp, mask):
+    """bool mask -> EVM boolean word (0 or 1)."""
+    shape = mask.shape + (LIMBS - 1,)
+    return xp.concatenate(
+        [xp.zeros(shape, dtype=xp.int32),
+         mask.astype(xp.int32)[..., None]], axis=-1)
+
+
+# -- bitwise -----------------------------------------------------------------
+
+
+def bit_and(xp, a, b):
+    return a & b
+
+
+def bit_or(xp, a, b):
+    return a | b
+
+
+def bit_xor(xp, a, b):
+    return a ^ b
+
+
+def bit_not(xp, a):
+    return 255 - a
+
+
+def byte_op(xp, index_word, value):
+    """EVM BYTE: byte `i` of `value` (0 = most significant), 0 for i >= 32.
+    With big-endian byte limbs this is a single limb gather."""
+    high = xp.any(index_word[..., :31] != 0, axis=-1)
+    small = index_word[..., 31]
+    oob = high | (small >= LIMBS)
+    idx = xp.where(oob, 0, small)
+    picked = xp.take_along_axis(value, idx[..., None], axis=-1)[..., 0]
+    picked = xp.where(oob, 0, picked)
+    shape = picked.shape + (LIMBS - 1,)
+    return xp.concatenate(
+        [xp.zeros(shape, dtype=xp.int32), picked[..., None]], axis=-1)
+
+
+# -- shifts ------------------------------------------------------------------
+
+
+def to_bits(xp, a):
+    """(..., 32) byte limbs -> (..., 256) bits, MSB first."""
+    shifts = xp.arange(7, -1, -1)
+    bits = (a[..., :, None] >> shifts) & 1
+    return bits.reshape(a.shape[:-1] + (WORD_BITS,))
+
+
+def from_bits(xp, bits):
+    grouped = bits.reshape(bits.shape[:-1] + (LIMBS, 8))
+    weights = 1 << xp.arange(7, -1, -1)
+    return xp.sum(grouped * weights, axis=-1).astype(xp.int32)
+
+
+def shift_amount(xp, w):
+    """Shift-word -> (amount clamped into [0, 255], oob mask for >=256)."""
+    high = xp.any(w[..., :30] != 0, axis=-1)
+    small = w[..., 30] * 256 + w[..., 31]
+    oob = high | (small >= WORD_BITS)
+    return xp.where(oob, 0, small), oob
+
+
+def shl(xp, shift_word, value):
+    amount, oob = shift_amount(xp, shift_word)
+    bits = to_bits(xp, value)
+    idx = xp.arange(WORD_BITS) + amount[..., None]
+    src = xp.take_along_axis(bits, xp.clip(idx, 0, WORD_BITS - 1), axis=-1)
+    out = xp.where((idx < WORD_BITS) & ~oob[..., None], src, 0)
+    return from_bits(xp, out)
+
+
+def shr(xp, shift_word, value):
+    amount, oob = shift_amount(xp, shift_word)
+    bits = to_bits(xp, value)
+    idx = xp.arange(WORD_BITS) - amount[..., None]
+    src = xp.take_along_axis(bits, xp.clip(idx, 0, WORD_BITS - 1), axis=-1)
+    out = xp.where((idx >= 0) & ~oob[..., None], src, 0)
+    return from_bits(xp, out)
+
+
+def sar(xp, shift_word, value):
+    amount, oob = shift_amount(xp, shift_word)
+    bits = to_bits(xp, value)
+    sign = bits[..., :1]
+    idx = xp.arange(WORD_BITS) - amount[..., None]
+    src = xp.take_along_axis(bits, xp.clip(idx, 0, WORD_BITS - 1), axis=-1)
+    out = xp.where((idx >= 0) & ~oob[..., None], src, sign)
+    return from_bits(xp, out)
+
+
+# -- SIGNEXTEND --------------------------------------------------------------
+
+
+def signextend(xp, pos_word, value):
+    """EVM SIGNEXTEND: sign byte sits at byte index 31 - pos (big-endian
+    limbs); every more significant limb becomes the sign fill. pos >= 31
+    is the identity."""
+    high = xp.any(pos_word[..., :30] != 0, axis=-1)
+    small = pos_word[..., 30] * 256 + pos_word[..., 31]
+    identity = high | (small >= 31)
+    sign_idx = xp.clip(31 - small, 0, 31)
+    sign_byte = xp.take_along_axis(value, sign_idx[..., None], axis=-1)[..., 0]
+    fill = ((sign_byte >> 7) & 1) * 255
+    keep = xp.arange(LIMBS) >= sign_idx[..., None]
+    return xp.where(keep | identity[..., None], value, fill[..., None])
+
+
+# -- memory offsets / small-int conversions ----------------------------------
+
+
+def mem_offset(xp, w, size, window):
+    """Offset word -> (small offset, oob mask). oob marks states whose
+    access [off, off+size) does not fit the dense window — they exit the
+    batch and replay on the per-state interpreter (which handles huge
+    concrete offsets via gas exhaustion)."""
+    high = xp.any(w[..., :29] != 0, axis=-1)
+    small = w[..., 29] * 65536 + w[..., 30] * 256 + w[..., 31]
+    oob = high | (small + size > window)
+    return xp.where(oob, 0, small), oob
+
+
+def small_to_word(xp, value):
+    """Non-negative int32 scalar array (< 2^31) -> word."""
+    cols = [value * 0] * (LIMBS - 4)
+    cols.append((value >> 24) & 0xFF)
+    cols.append((value >> 16) & 0xFF)
+    cols.append((value >> 8) & 0xFF)
+    cols.append(value & 0xFF)
+    return xp.stack(cols, axis=-1)

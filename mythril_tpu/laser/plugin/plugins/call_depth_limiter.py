@@ -20,6 +20,10 @@ class CallDepthLimit(LaserPlugin):
             if inner > self.call_depth_limit:
                 raise PluginSkipState
 
+        # frontier contract: the depth check reads only the transaction
+        # stack, which straight-line runs never change — once per batched
+        # run is equivalent to once per instruction
+        execute_state_hook.frontier_once_ok = True
         symbolic_vm.register_laser_hooks("execute_state", execute_state_hook)
 
 

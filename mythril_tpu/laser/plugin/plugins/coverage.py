@@ -61,6 +61,25 @@ class InstructionCoveragePlugin(LaserPlugin):
                 end_coverage - self.initial_coverage,
             )
 
+        def frontier_batch_hook(states, run):
+            # batched straight-line runs skip the per-instruction hook;
+            # every pc of the run executed for the completed states, so
+            # marking the whole run keeps the bitmap exact (the run-start
+            # pc was already marked by the once-per-run firing)
+            code_obj = states[0].environment.code
+            entry = self.coverage.get(code_obj.bytecode_hash)
+            if entry is None:
+                return
+            for pc in run.op_pcs:
+                index = code_obj.index_of_address(pc)
+                if index is not None:
+                    entry[1][index] = True
+
+        # frontier contract (laser/frontier/stepper.py): firing once per
+        # batched run is fine — the batch companion repaints the interior
+        execute_state_hook.frontier_once_ok = True
+        execute_state_hook.frontier_batch = frontier_batch_hook
+
         symbolic_vm.register_laser_hooks("execute_state", execute_state_hook)
         symbolic_vm.register_laser_hooks("stop_sym_exec", stop_sym_exec_hook)
         symbolic_vm.register_laser_hooks("start_sym_trans", start_sym_trans_hook)

@@ -53,6 +53,13 @@ class InstructionProfiler(LaserPlugin):
                 "Instruction profile (total %.2fs):\n%s", total, "\n".join(lines)
             )
 
+        # frontier contract: purely observational per-instruction timing.
+        # Batched runs skip both hooks as a PAIR (firing only the pre
+        # side would leak a pending slot into the next instruction); the
+        # profile then covers exactly the per-state fallback path, which
+        # is also what the interp_opcode_wall_top histogram reports.
+        pre_hook.frontier_transparent = True
+        post_hook.frontier_transparent = True
         symbolic_vm.register_instr_hooks("pre", "", pre_hook)
         symbolic_vm.register_instr_hooks("post", "", post_hook)
         symbolic_vm.register_laser_hooks("stop_sym_exec", stop_hook)

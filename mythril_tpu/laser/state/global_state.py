@@ -34,6 +34,12 @@ class GlobalState:
         self.last_return_data = last_return_data
         self.annotations: List = list(annotations or [])
         self.transient_storage = transient_storage or TransientStorage()
+        # (start_pc, end_pc) span of the vmapped-frontier run this state
+        # last exited mid-batch (laser/frontier/stepper.py): while its pc
+        # is inside the span it replays on the per-state interpreter
+        # instead of re-entering a batch at every interior pc of the same
+        # run. Deliberately NOT copied by clone() — forks leave the span.
+        self._frontier_skip_span = None
 
     @property
     def accounts(self):

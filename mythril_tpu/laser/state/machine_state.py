@@ -18,6 +18,16 @@ def _ceil32(value: int) -> int:
     return ((value + 31) // 32) * 32
 
 
+def memory_expansion_fee(words):
+    """Total memory fee for a memory of `words` 32-byte words (yellow
+    paper appendix G). Kept polynomial — no branches, no floats — so it
+    evaluates identically for python ints here and for batched int32
+    arrays inside the vmapped frontier step (laser/frontier/kernel.py
+    mirrors mem_extend with this exact formula)."""
+    return (words * GAS_MEMORY
+            + words * words // GAS_MEMORY_QUADRATIC_DENOMINATOR)
+
+
 class MachineStack(list):
     def append(self, element) -> None:
         if len(self) >= STACK_LIMIT:
@@ -76,14 +86,8 @@ class MachineState:
     def calculate_memory_gas(self, start: int, size: int) -> int:
         """Quadratic memory-expansion fee (reference machine_state.py:171-185)."""
         oldsize = self.memory_size // 32
-        old_totalfee = (
-            oldsize * GAS_MEMORY + oldsize**2 // GAS_MEMORY_QUADRATIC_DENOMINATOR
-        )
         newsize = _ceil32(start + size) // 32
-        new_totalfee = (
-            newsize * GAS_MEMORY + newsize**2 // GAS_MEMORY_QUADRATIC_DENOMINATOR
-        )
-        return new_totalfee - old_totalfee
+        return memory_expansion_fee(newsize) - memory_expansion_fee(oldsize)
 
     def mem_extend(self, start, size) -> None:
         """Grow memory, charging the expansion fee; symbolic bounds are left

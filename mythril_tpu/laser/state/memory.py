@@ -25,6 +25,22 @@ class Memory:
     def __init__(self):
         self._memory = K(256, 8, 0)
         self._msize = 0
+        # concrete shadow of the store chain, maintained incrementally so
+        # the vmapped frontier (laser/frontier/) can densify the touched
+        # window without walking the SMT array byte by byte:
+        #   _shadow     concrete index -> concrete byte value (int 0-255)
+        #   _sym_bytes  concrete indices last written with a SYMBOLIC value
+        #   _poisoned   a write at a SYMBOLIC index happened — the store
+        #               chain may alias any concrete index, so no dense
+        #               view of this memory is trustworthy for reads
+        self._shadow = {}
+        self._sym_bytes = set()
+        self._poisoned = False
+        # last dense_window result, invalidated by any write: batch
+        # admission and encode both densify the same untouched memory,
+        # and the window build (bytearray + full shadow scan) is the
+        # expensive part of the probe
+        self._dense_cache = None
 
     @property
     def size(self) -> int:
@@ -67,6 +83,24 @@ class Memory:
             value = symbol_factory.BitVecVal(value, 8)
         elif value.size != 8:
             value = Extract(7, 0, value)
+        # shadow maintenance: the term layer folds concrete arithmetic
+        # eagerly, so raw.is_const is a sufficient concreteness test here
+        # (no simplify call on the hot write path)
+        if isinstance(index, int):
+            concrete_index = index
+        elif index.raw.is_const:
+            concrete_index = index.raw.value
+        else:
+            concrete_index = None
+        if concrete_index is None:
+            self._poisoned = True
+        elif value.raw.is_const and not value.annotations:
+            self._shadow[concrete_index] = value.raw.value
+            self._sym_bytes.discard(concrete_index)
+        else:
+            self._shadow.pop(concrete_index, None)
+            self._sym_bytes.add(concrete_index)
+        self._dense_cache = None
         self._memory[_to_index(index)] = value
 
     def get_word_at(self, index) -> BitVec:
@@ -107,10 +141,35 @@ class Memory:
     def read_bytes_concrete(self, offset: int, length: int) -> List[BitVec]:
         return [self.get_byte(offset + i) for i in range(length)]
 
+    def dense_window(self, window: int):
+        """Concrete bytes [0, window) as a bytearray, or None when a dense
+        read view would be unsound: a symbolic-index write may alias any
+        byte, a symbolic byte value sits inside the window, or the array
+        carries taint annotations a dense read would fail to propagate.
+        Unwritten bytes are 0 — identical to the K(256, 8, 0) base array."""
+        cached = self._dense_cache
+        if cached is not None and cached[0] == window:
+            return cached[1]
+        if self._poisoned or self._memory.annotations:
+            result = None
+        elif self._sym_bytes and any(i < window for i in self._sym_bytes):
+            result = None
+        else:
+            result = bytearray(window)
+            for index, value in self._shadow.items():
+                if index < window:
+                    result[index] = value
+        self._dense_cache = (window, result)
+        return result
+
     def clone(self) -> "Memory":
         dup = Memory.__new__(Memory)
         dup._memory = self._memory.clone()
         dup._msize = self._msize
+        dup._shadow = dict(self._shadow)
+        dup._sym_bytes = set(self._sym_bytes)
+        dup._poisoned = self._poisoned
+        dup._dense_cache = None
         return dup
 
     def __deepcopy__(self, memo) -> "Memory":

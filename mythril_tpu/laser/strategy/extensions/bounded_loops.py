@@ -57,36 +57,46 @@ class BoundedLoopsStrategy:
     def run_check(self):
         return self.super_strategy.run_check()
 
+    def vet_state(self, state) -> bool:
+        """Per-yield loop accounting: append the state's JUMPDEST trace
+        and decide whether it stays under the loop bound. Shared by
+        __next__ AND the vmapped frontier's sibling collection
+        (laser/frontier/stepper.py) — states pulled into a batch bypass
+        __next__, and skipping the accounting there would let loops run
+        unbounded through back-to-back batched runs."""
+        annotations = [
+            a for a in state.annotations
+            if isinstance(a, JumpdestCountAnnotation)
+        ]
+        if not annotations:
+            annotation = JumpdestCountAnnotation()
+            state.annotate(annotation)
+        else:
+            annotation = annotations[0]
+        instruction = state.instruction
+        if instruction is not None and instruction.opcode == "JUMPDEST":
+            annotation.trace.append(state.mstate.pc)
+            from mythril_tpu.laser.transaction.models import (
+                ContractCreationTransaction,
+            )
+
+            bound = self.bound
+            if isinstance(
+                state.current_transaction, ContractCreationTransaction
+            ):
+                # loops in constructors run real iterations (reference
+                # :136-139 raises the bound for creation txs)
+                bound = max(bound, 128)
+            if _count_key_repetitions(annotation.trace) > bound:
+                log.debug(
+                    "loop bound %d exceeded at pc %d",
+                    bound, state.mstate.pc,
+                )
+                return False
+        return True
+
     def __next__(self):
         while True:
             state = self.super_strategy.__next__()
-            annotations = [
-                a for a in state.annotations
-                if isinstance(a, JumpdestCountAnnotation)
-            ]
-            if not annotations:
-                annotation = JumpdestCountAnnotation()
-                state.annotate(annotation)
-            else:
-                annotation = annotations[0]
-            instruction = state.instruction
-            if instruction is not None and instruction.opcode == "JUMPDEST":
-                annotation.trace.append(state.mstate.pc)
-                from mythril_tpu.laser.transaction.models import (
-                    ContractCreationTransaction,
-                )
-
-                bound = self.bound
-                if isinstance(
-                    state.current_transaction, ContractCreationTransaction
-                ):
-                    # loops in constructors run real iterations (reference
-                    # :136-139 raises the bound for creation txs)
-                    bound = max(bound, 128)
-                if _count_key_repetitions(annotation.trace) > bound:
-                    log.debug(
-                        "loop bound %d exceeded at pc %d",
-                        bound, state.mstate.pc,
-                    )
-                    continue
-            return state
+            if self.vet_state(state):
+                return state

@@ -67,6 +67,7 @@ class LaserEVM:
         use_reachability_check: bool = True,
         beam_width: Optional[int] = None,
         preanalysis=None,
+        vmap_frontier: bool = False,
     ):
         self.open_states: List[WorldState] = []
         self.work_list: List[GlobalState] = []
@@ -86,6 +87,13 @@ class LaserEVM:
         # direct engine users (concolic, vmtests) never set it, so their
         # behavior is untouched.
         self.preanalysis = preanalysis
+
+        # vmapped frontier (laser/frontier/): batched straight-line
+        # stepping over sibling states. Opt-in (SymExecWrapper sets it
+        # for analysis runs); the stepper is built lazily on first exec
+        # so every hook registration is visible to its eligibility gates
+        self.vmap_frontier = vmap_frontier
+        self._frontier = None
 
         strategy_kwargs = {}
         if beam_width is not None:
@@ -268,11 +276,18 @@ class LaserEVM:
     # -- the hot loop --------------------------------------------------------
 
     def exec(self, create: bool = False, track_gas: bool = False):
+        from mythril_tpu.smt.solver.statistics import SolverStatistics
+
         self._fire("start_exec")
         # states that produced no successors — the ended/leaf states the
         # VMTests harness asserts gas ranges on (reference svm.py:362-363)
         final_states: List[GlobalState] = []
         start = time.monotonic()
+        stats = SolverStatistics()
+        if self.vmap_frontier and self._frontier is None:
+            from mythril_tpu.laser.frontier import FrontierStepper
+
+            self._frontier = FrontierStepper(self)
         for global_state in self.strategy:
             if create and self.create_timeout:
                 if time.monotonic() - start > self.create_timeout:
@@ -288,17 +303,41 @@ class LaserEVM:
                 ):
                     log.info("execution timeout reached")
                     break
+            step_start = time.monotonic()
+            solver_before = stats.solver_time
             try:
-                new_states, op_code = self.execute_state(global_state)
+                # batched frontier step first: a straight-line run over
+                # every eligible sibling as one device step. op_code None
+                # keeps manage_cfg out (runs never contain CFG opcodes).
+                batched = (
+                    self._frontier.try_step(global_state)
+                    if self._frontier is not None else None
+                )
+                if batched is not None:
+                    new_states, op_code = batched, None
+                else:
+                    new_states, op_code = self.execute_state(global_state)
             except NotImplementedError:
                 log.debug("encountered unimplemented instruction")
                 continue
+            finally:
+                # solver seconds spent INSIDE handlers (concretization,
+                # tx-end confirmations) are already attributed to
+                # solver_time — subtract them so interp_wall isolates the
+                # stepping machinery the frontier targets
+                stats.add_interp_seconds(
+                    max(0.0, (time.monotonic() - step_start)
+                        - (stats.solver_time - solver_before)))
 
             # stochastic reachability pruning on forks (reference :351-358):
             # with probability pruning_factor, drop fork sides whose path
             # constraints are unsat. Auto: always prune on long-budget runs,
             # never on short ones (reference mythril_analyzer.py:78-82).
-            if len(new_states) > 1:
+            # op_code None = a batched frontier step: its multiple states
+            # are SIBLINGS of one straight-line run, not fork sides — no
+            # constraint changed, so feasibility solves (or pending-list
+            # parking) here would be pure waste
+            if op_code is not None and len(new_states) > 1:
                 pruning_factor = args.pruning_factor
                 if pruning_factor is None:
                     pruning_factor = 1.0 if self.execution_timeout > 300 else 0.0
@@ -419,22 +458,44 @@ class LaserEVM:
             # a pruner (e.g. dependency_pruner) vetoed this state
             return [], None
 
+        # per-opcode wall histogram of the per-state (fallback) path: the
+        # promotion shortlist for the frontier fast set (stats JSON
+        # interp_opcode_wall_top). Timed around the handler only — hooks
+        # and snapshots are engine overhead, not opcode cost.
+        from mythril_tpu.smt.solver.statistics import SolverStatistics
+
+        stats = SolverStatistics()
+        op_start = time.monotonic() if stats.enabled else 0.0
+        op_solver_before = stats.solver_time
         try:
-            new_states = instructions.execute(global_state, instr)
-        except VmException as error:
-            # exceptional halt: the frame reverts
-            transaction, return_snapshot = global_state.transaction_stack[-1]
-            self._fire_transaction_end_hooks(
-                global_state, transaction, return_snapshot, True
-            )
-            new_states = self.handle_vm_exception(
-                global_state, op_name, str(error)
-            )[0]
-        except TransactionStartSignal as signal:
-            new_states = self._start_inner_transaction(global_state, signal)
-            return new_states, op_name
-        except TransactionEndSignal as signal:
-            new_states = self._end_transaction(global_state, signal, op_name)
+            try:
+                new_states = instructions.execute(global_state, instr)
+            except VmException as error:
+                # exceptional halt: the frame reverts
+                transaction, return_snapshot = \
+                    global_state.transaction_stack[-1]
+                self._fire_transaction_end_hooks(
+                    global_state, transaction, return_snapshot, True
+                )
+                new_states = self.handle_vm_exception(
+                    global_state, op_name, str(error)
+                )[0]
+            except TransactionStartSignal as signal:
+                new_states = self._start_inner_transaction(
+                    global_state, signal)
+                return new_states, op_name
+            except TransactionEndSignal as signal:
+                new_states = self._end_transaction(
+                    global_state, signal, op_name)
+        finally:
+            if stats.enabled:
+                # solver seconds inside the handler (SHA3/RETURN
+                # concretization, tx-end confirmations) are solver cost,
+                # not opcode cost — without the subtraction STOP would
+                # top every histogram and say nothing about the fast set
+                stats.add_interp_opcode_wall(
+                    op_name, max(0.0, (time.monotonic() - op_start)
+                                 - (stats.solver_time - op_solver_before)))
 
         kept = []
         for state in new_states:

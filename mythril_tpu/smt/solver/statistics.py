@@ -69,6 +69,15 @@ class SolverStatistics:
         "prepare_prefix_fallbacks",
         "prepare_suffix_terms",
         "strash_xquery_merges",
+        # vmapped symbolic-execution frontier (laser/frontier/): batched
+        # device steps over sibling machine states, how many states each
+        # step actually carried (occupancy denominator is the padded slot
+        # count), and how many states exited a batch back to the per-state
+        # interpreter mid-run
+        "frontier_vmap_steps",
+        "frontier_states_stepped",
+        "frontier_fallback_exits",
+        "frontier_batch_slots",
     )
     _TIMERS = (
         "solver_time",
@@ -79,6 +88,14 @@ class SolverStatistics:
         # (route_device_seconds) — so future rounds can see where the wall
         # goes without re-profiling by hand
         "prepare_wall",
+        # wall spent stepping states in LaserEVM.exec (per-state
+        # execute_state calls + batched frontier steps), with solver
+        # seconds spent INSIDE instruction handlers (concretization,
+        # tx-end confirmations) subtracted out — they are already
+        # attributed to solver_time, and leaving them in would bury the
+        # stepping cost the frontier targets under solver noise. The
+        # interpreter-side counterpart of prepare_wall in the wall split.
+        "interp_wall",
     )
 
     def __new__(cls):
@@ -93,6 +110,11 @@ class SolverStatistics:
             # it lives outside _COUNTERS; reset/as_dict/absorb handle it
             # explicitly)
             cls._instance.prepare_suffix_hist = {}
+            # opcode -> [count, seconds] over the per-state interpreter
+            # path (the frontier's fallback oracle); as_dict emits the
+            # top-10 by cumulative wall so each bench round names the
+            # opcodes worth promoting into the frontier fast set next
+            cls._instance.interp_opcode_wall = {}
         return cls._instance
 
     def add_query(self, seconds: float) -> None:
@@ -335,6 +357,46 @@ class SolverStatistics:
         if self.enabled:
             self.strash_xquery_merges += count
 
+    def add_frontier_step(self, states: int, slots: int,
+                          fallback_exits: int) -> None:
+        """One batched frontier step: `states` sibling machine states
+        executed a straight-line opcode run as one device step, padded to
+        `slots` batch slots (the jit shape bucket); `fallback_exits` of
+        the batch bailed mid-run back to the per-state interpreter
+        (symbolic operand materialized, memory-window overflow, gas)."""
+        if self.enabled:
+            self.frontier_vmap_steps += 1
+            self.frontier_states_stepped += states
+            self.frontier_batch_slots += slots
+            self.frontier_fallback_exits += fallback_exits
+
+    def add_interp_seconds(self, seconds: float) -> None:
+        """Wall spent stepping states in LaserEVM.exec (per-state +
+        batched) — the interpreter component of the wall split."""
+        if self.enabled:
+            self.interp_wall += seconds
+
+    def add_interp_opcode_wall(self, opcode: str, seconds: float) -> None:
+        """One per-state (fallback-path) instruction execution: feeds the
+        per-opcode cumulative-wall histogram."""
+        if self.enabled:
+            record = self.interp_opcode_wall.get(opcode)
+            if record is None:
+                self.interp_opcode_wall[opcode] = [1, seconds]
+            else:
+                record[0] += 1
+                record[1] += seconds
+
+    @property
+    def frontier_batch_occupancy(self) -> float:
+        """Mean fraction of padded frontier batch slots holding live
+        sibling states (states_stepped + fallback_exits are all live on
+        entry; padding to the jit shape bucket is the waste)."""
+        if not self.frontier_batch_slots:
+            return 0.0
+        return (self.frontier_states_stepped + self.frontier_fallback_exits) \
+            / self.frontier_batch_slots
+
     @property
     def coalesce_occupancy(self) -> float:
         """Mean queries per coalescing-window flush (>1 means single-query
@@ -356,6 +418,16 @@ class SolverStatistics:
         for name in self._TIMERS:
             setattr(self, name, 0.0)
         self.prepare_suffix_hist = {}
+        self.interp_opcode_wall = {}
+
+    def interp_opcode_wall_top(self, n: int = 10) -> dict:
+        """Top-`n` fallback-path opcodes by cumulative wall:
+        {opcode: [count, seconds]} — which opcodes the per-state
+        interpreter still pays for (the frontier promotion shortlist)."""
+        ranked = sorted(self.interp_opcode_wall.items(),
+                        key=lambda item: item[1][1], reverse=True)
+        return {op: [count, round(seconds, 4)]
+                for op, (count, seconds) in ranked[:n]}
 
     def as_dict(self) -> dict:
         """Plain-data snapshot (pickles across the --jobs worker boundary;
@@ -365,7 +437,10 @@ class SolverStatistics:
             {name: round(getattr(self, name), 4) for name in self._TIMERS})
         out["device_occupancy"] = round(self.device_occupancy, 4)
         out["coalesce_occupancy"] = round(self.coalesce_occupancy, 4)
+        out["frontier_batch_occupancy"] = round(
+            self.frontier_batch_occupancy, 4)
         out["prepare_suffix_hist"] = dict(self.prepare_suffix_hist)
+        out["interp_opcode_wall_top"] = self.interp_opcode_wall_top()
         out["device"] = self.device_stats()
         return out
 
@@ -385,6 +460,14 @@ class SolverStatistics:
                               or {}).items():
             self.prepare_suffix_hist[bucket] = (
                 self.prepare_suffix_hist.get(bucket, 0) + int(count))
+        # the snapshot carries the worker's TOP slice, not the full
+        # histogram — folding it in keeps the parent's ranking honest for
+        # the opcodes workers actually reported
+        for op, (count, seconds) in (snapshot.get("interp_opcode_wall_top")
+                                     or {}).items():
+            record = self.interp_opcode_wall.setdefault(op, [0, 0.0])
+            record[0] += int(count)
+            record[1] += float(seconds)
 
     def __repr__(self):
         out = (f"Solver statistics: query count: {self.query_count}, "
@@ -433,6 +516,12 @@ class SolverStatistics:
                     f"/{self.prepare_prefix_fallbacks} fallbacks,"
                     f" {self.prepare_suffix_terms} suffix terms,"
                     f" {self.strash_xquery_merges} cross-query strash)")
+        if self.frontier_vmap_steps or self.interp_wall:
+            out += (f", frontier: {self.frontier_vmap_steps} vmap steps"
+                    f" ({self.frontier_states_stepped} states,"
+                    f" {self.frontier_fallback_exits} fallback exits,"
+                    f" occupancy {self.frontier_batch_occupancy:.2f}),"
+                    f" interp {self.interp_wall:.2f}s wall")
         if self.aig_nodes_before:
             out += (f", aig opt: {self.aig_nodes_before}"
                     f"->{self.aig_nodes_after} nodes"
