@@ -212,6 +212,7 @@ class MythrilAnalyzer:
                 "solve_cache", "transaction_sequences", "beam_width",
                 "disable_coverage_strategy", "jobs", "no_preanalysis",
                 "no_aig_opt", "no_incremental_prep", "no_vmap_frontier",
+                "trace",
             ):
                 if hasattr(cmd_args, field) and getattr(cmd_args, field) is not None:
                     setattr(args, field, getattr(cmd_args, field))
@@ -223,26 +224,52 @@ class MythrilAnalyzer:
 
     def fire_lasers(self, modules: Optional[List[str]] = None,
                     transaction_count: Optional[int] = None) -> Report:
+        import os
+
         from mythril_tpu.analysis.module import ModuleLoader
+        from mythril_tpu.observe import TRACE_ENV, get_tracer
 
         for module in ModuleLoader().get_detection_modules():
             module.reset_module()
             module.reset_cache()
         stats = SolverStatistics()
         stats.enabled = True
+        trace_path = getattr(args, "trace", None) \
+            or os.environ.get(TRACE_ENV)
+        if trace_path:
+            get_tracer().enable(trace_path)
         tx_count = transaction_count or args.transaction_count
 
-        if args.jobs > 1 and len(self.contracts) > 1 and self.eth is None:
-            all_issues, exceptions = self._fire_lasers_parallel(
-                modules, tx_count)
-        else:
-            all_issues = []
-            exceptions = []
-            for contract in self.contracts:
-                issues, contract_exceptions = self._analyze_one_contract(
-                    contract, modules, tx_count, stats=stats)
-                all_issues.extend(issues)
-                exceptions.extend(contract_exceptions)
+        # telemetry must survive the run that produced it: stats JSON and
+        # the trace are written from the finally, so an execution timeout
+        # or a module exception that escapes the per-contract capture no
+        # longer loses the whole run's telemetry (the `completed` tag in
+        # the JSON says which case the reader is looking at)
+        completed = False
+        all_issues: List[Issue] = []
+        exceptions: List[str] = []
+        try:
+            if args.jobs > 1 and len(self.contracts) > 1 \
+                    and self.eth is None:
+                all_issues, exceptions = self._fire_lasers_parallel(
+                    modules, tx_count)
+            else:
+                for contract in self.contracts:
+                    issues, contract_exceptions = \
+                        self._analyze_one_contract(
+                            contract, modules, tx_count, stats=stats)
+                    all_issues.extend(issues)
+                    exceptions.extend(contract_exceptions)
+            completed = True
+        finally:
+            self._dump_stats_json(stats, completed=completed)
+            if trace_path:
+                tracer = get_tracer()
+                tracer.write()
+                # a later fire_lasers in this process starts clean: leaving
+                # the tracer enabled would keep every span site allocating
+                # (and re-export this run's events into the next trace)
+                tracer.reset()
 
         report = Report(
             contracts=self.contracts,
@@ -250,25 +277,27 @@ class MythrilAnalyzer:
         )
         for issue in all_issues:
             report.append_issue(issue)
-        self._dump_stats_json(stats)
         return report
 
     @staticmethod
-    def _dump_stats_json(stats) -> None:
+    def _dump_stats_json(stats, completed: bool = True) -> None:
         """MYTHRIL_TPU_STATS_JSON=<path>: write the run's SolverStatistics
         (routing counters, device hits/cap-rejects, batch occupancy,
-        per-route wall) as one JSON object — bench.py reads this from each
-        analyze subprocess so BENCH_r0N.json can report where queries
-        actually went."""
+        per-route wall, the roofline section) as one JSON object —
+        bench.py reads this from each analyze subprocess so BENCH_r0N.json
+        can report where queries actually went. `completed` distinguishes
+        a clean run from telemetry salvaged by the finally path."""
         import json
         import os
 
         path = os.environ.get("MYTHRIL_TPU_STATS_JSON")
         if not path:
             return
+        payload = stats.as_dict()
+        payload["completed"] = bool(completed)
         try:
             with open(path, "w") as fd:
-                json.dump(stats.as_dict(), fd)
+                json.dump(payload, fd)
         except OSError:
             log.warning("could not write solver stats to %s", path)
 
@@ -290,21 +319,25 @@ class MythrilAnalyzer:
             from mythril_tpu.support.loader import DynLoader
 
             dynloader = DynLoader(self.eth)
+        from mythril_tpu.observe import span as trace_span
+
         try:
-            sym = SymExecWrapper(
-                contract,
-                self.address,
-                self.strategy,
-                dynloader=dynloader,
-                max_depth=args.max_depth,
-                execution_timeout=args.execution_timeout,
-                loop_bound=args.loop_bound,
-                create_timeout=args.create_timeout,
-                transaction_count=tx_count,
-                modules=modules,
-                compulsory_statespace=False,
-            )
-            issues = fire_lasers(sym, white_list=modules)
+            with trace_span("analyze.contract", cat="analyze",
+                            contract=contract.name):
+                sym = SymExecWrapper(
+                    contract,
+                    self.address,
+                    self.strategy,
+                    dynloader=dynloader,
+                    max_depth=args.max_depth,
+                    execution_timeout=args.execution_timeout,
+                    loop_bound=args.loop_bound,
+                    create_timeout=args.create_timeout,
+                    transaction_count=tx_count,
+                    modules=modules,
+                    compulsory_statespace=False,
+                )
+                issues = fire_lasers(sym, white_list=modules)
         except KeyboardInterrupt:
             log.critical("keyboard interrupt: retrieving partial results")
             issues = retrieve_callback_issues(modules)
@@ -345,16 +378,23 @@ class MythrilAnalyzer:
              dict(args.__dict__))
             for idx, contract in enumerate(self.contracts)
         ]
+        from mythril_tpu.observe import get_tracer
+
         context = mp.get_context("spawn")
         stats = SolverStatistics()
+        tracer = get_tracer()
         done = {}  # contract idx -> (issues, exceptions)
         interrupted = False
         try:
             with context.Pool(processes=workers) as pool:
-                for idx, issues, contract_exceptions, stats_snapshot in \
+                for idx, issues, contract_exceptions, stats_snapshot, \
+                        trace_events in \
                         pool.imap_unordered(_corpus_worker, payloads):
                     done[idx] = (issues, contract_exceptions)
                     stats.absorb(stats_snapshot)
+                    # worker spans carry their own pid: each worker gets
+                    # its own process lane in the merged timeline
+                    tracer.absorb_events(trace_events)
         except KeyboardInterrupt:
             interrupted = True
             log.critical(
@@ -457,8 +497,13 @@ def _corpus_worker(payload):
     Rebuilds the args singleton from the parent's snapshot (spawn starts
     from a fresh interpreter), resets the per-process module/solver state,
     and runs the standard single-contract path. Returns (idx, issues,
-    exceptions, stats snapshot) — all plain data, pickles back to the
-    parent, which aggregates the solver statistics across workers."""
+    exceptions, stats snapshot, trace events) — all plain data, pickles
+    back to the parent, which aggregates the solver statistics and merges
+    the trace spans (pid-lane per worker) across workers."""
+    import os
+
+    from mythril_tpu.observe import TRACE_ENV, get_tracer
+
     idx, contract, address, strategy, modules, tx_count, args_state = payload
     args.__dict__.update(args_state)
     args.jobs = 1  # workers never re-fan-out
@@ -469,13 +514,17 @@ def _corpus_worker(payload):
         module.reset_cache()
     stats = SolverStatistics()
     stats.enabled = True
+    if getattr(args, "trace", None) or os.environ.get(TRACE_ENV):
+        # collect-only: the parent writes the merged timeline
+        get_tracer().enable(None)
     disassembler = MythrilDisassembler()
     disassembler.contracts.append(contract)
     analyzer = MythrilAnalyzer(disassembler, strategy=strategy,
                                address=address)
     issues, exceptions = analyzer._analyze_one_contract(
         contract, modules, tx_count, stats=stats)
-    return idx, issues, exceptions, stats.as_dict()
+    return (idx, issues, exceptions, stats.as_dict(),
+            get_tracer().drain_events())
 
 
 def _signature_db():

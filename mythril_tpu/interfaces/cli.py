@@ -214,6 +214,13 @@ def add_analysis_args(parser) -> None:
                              "straight-line opcode runs as one device "
                              "step); env override: "
                              "MYTHRIL_TPU_VMAP_FRONTIER=0|1")
+    parser.add_argument("--trace", metavar="PATH", default=None,
+                        help="write a Chrome-trace-event / Perfetto span "
+                             "timeline of the whole pipeline (analyze, "
+                             "LASER exec, frontier, solver prepare, "
+                             "router, device pack/ship/kernel, CDCL "
+                             "settle, cache tiers, scheduler flushes) to "
+                             "PATH; env equivalent: MYTHRIL_TPU_TRACE")
     parser.add_argument("--disable-mutation-pruner", action="store_true")
     parser.add_argument("--disable-coverage-strategy", action="store_true")
     parser.add_argument("--disable-dependency-pruning", action="store_true")

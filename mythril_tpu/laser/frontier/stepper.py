@@ -37,6 +37,7 @@ from typing import List, Optional
 
 from mythril_tpu.laser.frontier import dense, fastset, kernel
 from mythril_tpu.laser.plugin.signals import PluginSkipState
+from mythril_tpu.observe.tracer import NULL_SPAN, span as trace_span
 
 log = logging.getLogger(__name__)
 
@@ -238,6 +239,11 @@ class FrontierStepper:
         if not dense.state_encodable(lead, run):
             lead._frontier_skip_span = (run.start_pc, run.end_pc)
             return None
+        with trace_span("laser.frontier_step", cat="laser", pc=pc) as sp:
+            return self._step_batch(lead, run, sp)
+
+    def _step_batch(self, lead, run, sp=NULL_SPAN) -> Optional[List]:
+        """The batched step itself (traced as laser.frontier_step)."""
         svm = self.svm
         batch = self._collect_siblings(lead, run)
 
@@ -307,6 +313,9 @@ class FrontierStepper:
         SolverStatistics().add_frontier_step(
             states=len(completed), slots=pad,
             fallback_exits=len(survivors) - len(completed))
+        sp.set(states=len(completed), slots=pad,
+               fallbacks=len(survivors) - len(completed),
+               ops=len(run.ops))
         if completed:
             for hook in svm._hooks["execute_state"]:
                 replay = getattr(hook, "frontier_batch", None)

@@ -18,6 +18,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from mythril_tpu.laser import instructions
 from mythril_tpu.laser.cfg import Edge, JumpType, Node, NodeFlags
+from mythril_tpu.observe.tracer import traced
 from mythril_tpu.laser.evm_exceptions import VmException
 from mythril_tpu.laser.plugin.signals import PluginSkipState, PluginSkipWorldState
 from mythril_tpu.laser.state.global_state import GlobalState
@@ -275,6 +276,7 @@ class LaserEVM:
 
     # -- the hot loop --------------------------------------------------------
 
+    @traced("laser.exec", cat="laser")
     def exec(self, create: bool = False, track_gas: bool = False):
         from mythril_tpu.smt.solver.statistics import SolverStatistics
 

@@ -1,0 +1,29 @@
+"""Pipeline observability: hierarchical span tracing + roofline accounting.
+
+Two halves, both riding the same SolverStatistics emission path so bench
+rounds can say WHERE the remaining gap is instead of just the wall:
+
+  tracer.py    a thread-safe hierarchical span tracer instrumenting every
+               pipeline stage (analyze -> LASER exec -> frontier/fallback
+               -> solver prepare -> router -> pack/ship/kernel/settle ->
+               cache tiers -> scheduler flushes), exported as a
+               Chrome-trace-event / Perfetto JSON timeline
+               (MYTHRIL_TPU_TRACE=<path>), pid/tid-mapped so --jobs
+               workers merge into one timeline. Near-zero cost when
+               disabled: span() returns one shared no-op object.
+  roofline.py  per-stage attained-vs-attainable throughput against
+               ceilings derived from the router's persisted
+               micro-calibration profile (cells/s for the kernel, bytes/s
+               for pack/ship, a calibrated CDCL rate for settle), plus a
+               reconciled solver-wall decomposition whose components sum
+               to the measured total. Emitted in the stats JSON under
+               "roofline"; bench.py ranks the top gap stages per leg.
+"""
+
+from mythril_tpu.observe.tracer import (  # noqa: F401 (public API)
+    TRACE_ENV,
+    Tracer,
+    get_tracer,
+    span,
+    traced,
+)

@@ -1,0 +1,183 @@
+"""Speed-of-light roofline accounting (SOLAR-style attained vs attainable).
+
+build(stats) assembles the "roofline" section of the stats JSON from
+three sources:
+
+  SolverStatistics   settle work/wall (cdcl_clauses / settle_wall) and the
+                     solver-wall timers for the decomposition — these
+                     aggregate across --jobs workers via absorb().
+  device backend     pack/ship/kernel work and busy seconds (pack_bytes,
+                     ship_bytes, cells_stepped vs pack/ship/solve walls).
+                     Per-process, like the rest of the device stats: the
+                     backend object never crosses the spawn boundary.
+  router profile     attainable ceilings from the micro-calibration
+                     (tpu/router.attainable_rates): cells/s for the
+                     kernel, bytes/s for pack/ship, clauses/s for settle.
+                     None when the router never calibrated this run (the
+                     stage then reports attained with no ceiling).
+
+Each stage row carries `sol_gap_s` — the seconds the stage would get back
+if it ran at its attainable rate (busy_s - work/attainable) — which is the
+one unit comparable ACROSS stages; bench.py ranks the top gap stages per
+leg with it. Ceilings are COLD-path micro-measurements on one calibration
+shape, so a warm, cache-amortized stage can legitimately attain more than
+its ceiling (pack on repeated shapes, settle on loaded sessions): that
+clamps to headroom 1.0 / sol_gap_s 0.0 and reads as "this stage is not
+the gap" — the ranking stays honest even where the ceiling is
+conservative. The wall decomposition is reconciled by construction: the
+independently-measured components (prepare / settle / crosscheck / device)
+plus the explicit `other_s` residual sum to the measured solver wall, and
+`attributed_frac` says how much of the wall the named components explain.
+
+Everything here is read-only over already-collected counters and must
+never break a stats emission: build() degrades to an empty-ceiling report
+on any internal error.
+"""
+
+import logging
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+# the four device-path stages with measured work, busy wall, and a
+# calibrated ceiling. One tuple drives build(), the check_stats_keys lint,
+# and bench.py's ROOFLINE_STAGES gap table — adding a stage is one entry
+# here plus its work/rate wiring below.
+STAGES = ("pack", "ship", "kernel", "settle")
+
+_UNITS = {
+    "pack": "bytes/s",
+    "ship": "bytes/s",
+    "kernel": "cells/s",
+    "settle": "clauses/s",
+}
+
+
+def _stage_row(work, busy_s: float, attainable: Optional[float],
+               units: str) -> dict:
+    attained = (work / busy_s) if busy_s else 0.0
+    row = {
+        "units": units,
+        "work": int(work),
+        "busy_s": round(busy_s, 4),
+        "attained": round(attained, 2),
+        "attainable": round(attainable, 2) if attainable else None,
+    }
+    if attainable and busy_s:
+        # seconds recoverable at speed of light — the cross-stage ranking
+        # unit (a stage at 10% of ceiling for 0.1 s matters less than one
+        # at 80% for 30 s)
+        row["sol_gap_s"] = round(max(busy_s - work / attainable, 0.0), 4)
+        row["headroom"] = round(min(attained / attainable, 1.0), 4)
+    else:
+        row["sol_gap_s"] = None
+        row["headroom"] = None
+    return row
+
+
+def _device_stats() -> dict:
+    from mythril_tpu.tpu import backend as device_backend
+
+    if device_backend._backend is None:
+        return {}
+    return device_backend._backend.stats()
+
+
+def _router_rates() -> dict:
+    from mythril_tpu.tpu import router as router_mod
+
+    if router_mod._router is None:
+        return {}
+    try:
+        return router_mod._router.attainable_rates()
+    except Exception:
+        return {}
+
+
+def build(stats) -> dict:
+    """The stats-JSON "roofline" section for a SolverStatistics snapshot.
+    Never raises — a telemetry report must not break the run it reports."""
+    try:
+        return _build(stats)
+    except Exception:
+        log.exception("roofline accounting failed; emitting empty report")
+        return {
+            "stages": {name: _stage_row(0, 0.0, None, _UNITS[name])
+                       for name in STAGES},
+            "wall": {"solver_total_s": 0.0},
+        }
+
+
+def _build(stats) -> dict:
+    device = _device_stats()
+    rates = _router_rates()
+
+    stages = {
+        "pack": _stage_row(
+            device.get("pack_bytes", 0),
+            device.get("pack_seconds", 0.0),
+            rates.get("pack_bytes_s"),
+            _UNITS["pack"]),
+        "ship": _stage_row(
+            device.get("ship_bytes", 0),
+            device.get("ship_seconds", 0.0),
+            rates.get("ship_bytes_s"),
+            _UNITS["ship"]),
+        "kernel": _stage_row(
+            device.get("cells_stepped", 0),
+            device.get("solve_seconds", 0.0),
+            rates.get("kernel_cells_s"),
+            _UNITS["kernel"]),
+        "settle": _stage_row(
+            stats.cdcl_clauses,
+            stats.settle_wall,
+            rates.get("settle_clauses_s"),
+            _UNITS["settle"]),
+    }
+
+    total = stats.solver_time
+    prepare = stats.prepare_wall
+    settle = stats.settle_wall
+    crosscheck = stats.crosscheck_wall
+    device_s = stats.route_device_seconds
+    attributed = prepare + settle + crosscheck + device_s
+    wall = {
+        # the decomposition reconciles by construction: named components
+        # + other_s == solver_total_s (other_s = cache probes, memo
+        # lookups, marshalling — measured as the residual, never hidden)
+        "solver_total_s": round(total, 4),
+        "prepare_s": round(prepare, 4),
+        "settle_s": round(settle, 4),
+        "crosscheck_s": round(crosscheck, 4),
+        "device_s": round(device_s, 4),
+        "other_s": round(max(total - attributed, 0.0), 4),
+        "attributed_frac": round(min(attributed / total, 1.0), 4)
+        if total else 1.0,
+        # interpreter wall is the ENGINE-side counterpart (outside the
+        # solver wall); reported here so one section carries the split
+        "interp_s": round(stats.interp_wall, 4),
+    }
+    return {"stages": stages, "wall": wall}
+
+
+def top_gaps(roofline: dict, n: int = 3) -> list:
+    """Top-`n` stages by sol_gap_s (descending) from a built roofline
+    section — the per-leg "where the remaining gap is" table bench.py
+    attaches to every analyze leg. Stages without a calibrated ceiling
+    rank last (gap unknown is not gap zero)."""
+    stages = (roofline or {}).get("stages", {})
+    ranked = sorted(
+        ((name, row) for name, row in stages.items()),
+        # unknown gap (no calibrated ceiling) ranks strictly LAST — gap
+        # unknown is not gap zero, and must not tie with at-ceiling stages
+        key=lambda item: (item[1].get("sol_gap_s") is None,
+                          -(item[1].get("sol_gap_s") or 0.0)),
+    )
+    return [
+        {"stage": name,
+         "sol_gap_s": row.get("sol_gap_s"),
+         "attained": row.get("attained"),
+         "attainable": row.get("attainable"),
+         "units": row.get("units")}
+        for name, row in ranked[:n]
+    ]

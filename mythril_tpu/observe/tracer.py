@@ -1,0 +1,263 @@
+"""Hierarchical span tracer with Chrome-trace-event / Perfetto export.
+
+Usage (the instrumented seams throughout the pipeline):
+
+    from mythril_tpu.observe.tracer import span, traced
+
+    with span("router.dispatch", cat="router", queries=len(problems)) as sp:
+        ...
+        sp.set(hits=hits)          # attach attributes discovered mid-span
+
+    @traced("laser.exec", cat="laser")
+    def exec(self, ...): ...
+
+Design constraints, in priority order:
+
+  disabled cost   tracing is OFF unless MYTHRIL_TPU_TRACE (or --trace) set
+                  a path. span() then returns ONE shared no-op object —
+                  the per-call-site cost is a module-global load, a
+                  truthiness check, and a context-manager protocol on an
+                  empty object (guarded under 2% of a stress run by the
+                  tier-1 overhead test). No thread-local, no allocation.
+  thread safety   completed spans append to a lock-protected list; the
+                  hierarchy needs no explicit parent tracking because
+                  Perfetto nests complete ("X") events by containment per
+                  (pid, tid) lane, and spans measured with one shared
+                  perf_counter anchor are contained by construction.
+  process merge   timestamps are wall-clock-anchored microseconds
+                  (anchor = time.time() at enable + perf_counter deltas),
+                  so events recorded in --jobs worker processes — drained
+                  as plain dicts through the existing stats-snapshot
+                  pickle channel and absorbed by the parent — land on the
+                  same timeline, each under its own pid lane.
+
+Export is the Chrome trace event format (the `traceEvents` array form):
+one "X" (complete) event per span with ph/ts/dur/pid/tid/name/cat, plus
+"M" process_name metadata per merged pid. Load the file in Perfetto
+(ui.perfetto.dev) or chrome://tracing.
+"""
+
+import json
+import logging
+import os
+import threading
+import time
+from functools import wraps
+from typing import Dict, List, Optional
+
+log = logging.getLogger(__name__)
+
+TRACE_ENV = "MYTHRIL_TPU_TRACE"
+
+
+class _NullSpan:
+    """Shared do-nothing span — the entire disabled-mode code path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        return False
+
+    def set(self, **attrs):
+        return self
+
+
+NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    """One live span; records itself into the tracer on exit."""
+
+    __slots__ = ("_tracer", "name", "cat", "args", "_ts_us", "_t0")
+
+    def __init__(self, tracer, name, cat, attrs):
+        self._tracer = tracer
+        self.name = name
+        self.cat = cat
+        self.args = attrs
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        self._ts_us = self._tracer._anchor_wall_us + (
+            self._t0 - self._tracer._anchor_perf) * 1e6
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        dur_us = (time.perf_counter() - self._t0) * 1e6
+        self._tracer._record(self.name, self.cat, self._ts_us, dur_us,
+                             self.args)
+        return False
+
+    def set(self, **attrs):
+        self.args.update(attrs)
+        return self
+
+
+class Tracer:
+    """Process-global span collector (singleton, like SolverStatistics)."""
+
+    _instance: Optional["Tracer"] = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            inst = super().__new__(cls)
+            inst.enabled = False
+            inst.path = None
+            inst._events = []
+            inst._lock = threading.Lock()
+            inst._pid = os.getpid()
+            inst._anchor_wall_us = 0.0
+            inst._anchor_perf = 0.0
+            cls._instance = inst
+        return cls._instance
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def enable(self, path: Optional[str] = None) -> None:
+        """Start collecting spans. `path` is where write() will export the
+        timeline; workers pass None (they drain events back to the parent
+        instead of writing a file)."""
+        self.path = path
+        self._pid = os.getpid()
+        # one shared anchor: perf_counter gives monotonic sub-µs deltas,
+        # the wall clock gives a base comparable ACROSS processes
+        self._anchor_perf = time.perf_counter()
+        self._anchor_wall_us = time.time() * 1e6
+        self.enabled = True
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def reset(self) -> None:
+        """Testing hook: drop collected events and disable."""
+        with self._lock:
+            self._events = []
+        self.enabled = False
+        self.path = None
+
+    # -- recording -----------------------------------------------------------
+
+    def _record(self, name, cat, ts_us, dur_us, attrs) -> None:
+        event = {
+            "name": name,
+            "cat": cat,
+            "ph": "X",
+            "ts": round(ts_us, 3),
+            "dur": round(dur_us, 3),
+            "pid": self._pid,
+            "tid": threading.get_ident(),
+        }
+        if attrs:
+            event["args"] = {k: _jsonable(v) for k, v in attrs.items()}
+        with self._lock:
+            self._events.append(event)
+
+    # -- cross-process merge (--jobs workers) --------------------------------
+
+    def drain_events(self) -> List[dict]:
+        """Take every collected event (worker side of the merge: the
+        returned plain dicts pickle through the corpus-worker payload)."""
+        with self._lock:
+            events, self._events = self._events, []
+        return events
+
+    def absorb_events(self, events) -> None:
+        """Fold a worker's drained events into this (parent) tracer —
+        they already carry the worker's pid, so each worker gets its own
+        process lane in the merged timeline."""
+        if not events:
+            return
+        with self._lock:
+            self._events.extend(events)
+
+    # -- aggregation / export ------------------------------------------------
+
+    def summary(self) -> Dict[str, list]:
+        """{stage name: [span count, total seconds]} over every collected
+        event — the span-summary section of the stats JSON."""
+        out: Dict[str, list] = {}
+        with self._lock:
+            events = list(self._events)
+        for event in events:
+            record = out.setdefault(event["name"], [0, 0.0])
+            record[0] += 1
+            record[1] += event["dur"] / 1e6
+        for record in out.values():
+            record[1] = round(record[1], 4)
+        return out
+
+    def write(self, path: Optional[str] = None) -> Optional[str]:
+        """Export the collected timeline as Chrome trace JSON. Returns the
+        written path, or None when there was nowhere to write."""
+        path = path or self.path
+        if not path:
+            return None
+        with self._lock:
+            events = list(self._events)
+        # normalize to a zero-based timeline (comparable across merged
+        # pids: every anchor is the shared wall clock)
+        base = min((e["ts"] for e in events), default=0.0)
+        out_events = []
+        pids = []
+        for event in events:
+            event = dict(event)
+            event["ts"] = round(event["ts"] - base, 3)
+            out_events.append(event)
+            if event["pid"] not in pids:
+                pids.append(event["pid"])
+        meta = [
+            {"name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+             "args": {"name": ("mythril_tpu analyzer" if pid == self._pid
+                               else f"mythril_tpu worker {pid}")}}
+            for pid in pids
+        ]
+        payload = {"traceEvents": meta + out_events,
+                   "displayTimeUnit": "ms"}
+        try:
+            with open(path, "w") as fd:
+                json.dump(payload, fd)
+        except OSError as error:
+            log.warning("could not write trace to %s (%s)", path, error)
+            return None
+        log.info("wrote %d trace spans to %s (load in ui.perfetto.dev)",
+                 len(out_events), path)
+        return path
+
+
+def _jsonable(value):
+    if isinstance(value, (bool, int, float, str)) or value is None:
+        return value
+    return str(value)
+
+
+def get_tracer() -> Tracer:
+    return Tracer()
+
+
+def span(name: str, cat: str = "stage", **attrs):
+    """A span context manager, or the shared no-op when tracing is off.
+    THE hot-path entry point: keep the disabled branch allocation-free."""
+    tracer = Tracer._instance
+    if tracer is None or not tracer.enabled:
+        return NULL_SPAN
+    return _Span(tracer, name, cat, attrs)
+
+
+def traced(name: str, cat: str = "stage"):
+    """Decorator form for whole-function stages."""
+
+    def decorate(func):
+        @wraps(func)
+        def wrapped(*args, **kwargs):
+            tracer = Tracer._instance
+            if tracer is None or not tracer.enabled:
+                return func(*args, **kwargs)
+            with _Span(tracer, name, cat, {}):
+                return func(*args, **kwargs)
+
+        return wrapped
+
+    return decorate

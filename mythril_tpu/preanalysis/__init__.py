@@ -71,7 +71,11 @@ def get_code_summary(disassembly) -> Optional[CodeSummary]:
     summary = None
     try:
         if isinstance(disassembly.bytecode, bytes) and disassembly.bytecode:
-            summary = CodeSummary(disassembly)
+            from mythril_tpu.observe.tracer import span as trace_span
+
+            with trace_span("preanalysis.summary", cat="analyze",
+                            code_bytes=len(disassembly.bytecode)):
+                summary = CodeSummary(disassembly)
     except Exception:
         # pre-analysis must never break an analysis: degrade to "no info"
         log.exception("preanalysis failed; continuing without summaries")

@@ -26,6 +26,11 @@ log = logging.getLogger(__name__)
 CALIBRATION_SCHEMA_VERSION = 1
 _FILENAME = "calibration.json"
 
+# stage speed-of-light rates persisted beside per_cell_s (additive keys —
+# same schema version; old entries without them simply report no ceiling
+# for those stages until the next fresh measurement)
+STAGE_RATE_KEYS = ("pack_bytes_s", "ship_bytes_s", "settle_clauses_s")
+
 
 def _path() -> str:
     from mythril_tpu.service import cache_dir
@@ -43,10 +48,12 @@ def _enabled() -> bool:
     return disk_tier_enabled()
 
 
-def load_per_cell_latency(platform: Optional[str], restarts: int,
-                          steps: int) -> Optional[float]:
-    """Cached seconds per (cell x step) for this platform + cell profile,
-    or None (measure)."""
+def load_profile(platform: Optional[str], restarts: int,
+                 steps: int) -> Optional[dict]:
+    """The cached measurement entry for this platform + cell profile —
+    {"per_cell_s": float, optional stage rates (STAGE_RATE_KEYS)} — or
+    None (measure). A valid per_cell_s gates the whole entry: the cap
+    sizing must never run off a corrupt measurement."""
     if not platform or not _enabled():
         return None
     try:
@@ -62,12 +69,26 @@ def load_per_cell_latency(platform: Optional[str], restarts: int,
     value = entry.get("per_cell_s")
     if not isinstance(value, (int, float)) or value <= 0:
         return None
-    return float(value)
+    out = {"per_cell_s": float(value)}
+    for key in STAGE_RATE_KEYS:
+        rate = entry.get(key)
+        if isinstance(rate, (int, float)) and rate > 0:
+            out[key] = float(rate)
+    return out
 
 
-def save_per_cell_latency(platform: Optional[str], restarts: int,
-                          steps: int, per_cell_s: float) -> None:
-    if not platform or not _enabled() or not per_cell_s:
+def load_per_cell_latency(platform: Optional[str], restarts: int,
+                          steps: int) -> Optional[float]:
+    """Cached seconds per (cell x step) for this platform + cell profile,
+    or None (measure)."""
+    profile = load_profile(platform, restarts, steps)
+    return profile["per_cell_s"] if profile else None
+
+
+def save_profile(platform: Optional[str], restarts: int, steps: int,
+                 profile: dict) -> None:
+    """Persist a measurement entry (per_cell_s + any stage rates)."""
+    if not platform or not _enabled() or not profile.get("per_cell_s"):
         return
     path = _path()
     try:
@@ -83,7 +104,8 @@ def save_per_cell_latency(platform: Optional[str], restarts: int,
             except (OSError, ValueError):
                 pass
             payload["entries"][_key(platform, restarts, steps)] = {
-                "per_cell_s": per_cell_s,
+                **{key: value for key, value in profile.items()
+                   if isinstance(value, (int, float)) and value > 0},
                 "measured_at": int(time.time()),
             }
             from mythril_tpu.service.store import atomic_write_json
@@ -91,3 +113,8 @@ def save_per_cell_latency(platform: Optional[str], restarts: int,
             atomic_write_json(path, payload)
     except OSError as error:
         log.info("could not persist calibration (%s)", error)
+
+
+def save_per_cell_latency(platform: Optional[str], restarts: int,
+                          steps: int, per_cell_s: float) -> None:
+    save_profile(platform, restarts, steps, {"per_cell_s": per_cell_s})

@@ -146,6 +146,7 @@ class CoalescingScheduler:
         distinct crosscheck flag (submission order preserved per group)."""
         if not self._buffer:
             return
+        from mythril_tpu.observe.tracer import span as trace_span
         from mythril_tpu.smt.solver.statistics import SolverStatistics
         from mythril_tpu.support.model import get_models_batch
 
@@ -155,20 +156,22 @@ class CoalescingScheduler:
         groups = {}
         for entry in buffered:
             groups.setdefault(entry[2], []).append(entry)
-        for flag, entries in groups.items():
-            try:
-                outcomes = get_models_batch(
-                    [constraints for _h, constraints, _f in entries],
-                    crosscheck=flag,
-                )
-            except Exception:
-                # a handle must never dangle: degrade the cohort to
-                # unknown (callers treat unknown as possibly-feasible)
-                log.exception("coalesced solve flush failed; cohort of %d "
-                              "degraded to unknown", len(entries))
-                outcomes = [("unknown", None)] * len(entries)
-            for (handle, _c, _f), outcome in zip(entries, outcomes):
-                handle._resolve(outcome)
+        with trace_span("scheduler.flush", cat="service",
+                        queries=len(buffered), groups=len(groups)):
+            for flag, entries in groups.items():
+                try:
+                    outcomes = get_models_batch(
+                        [constraints for _h, constraints, _f in entries],
+                        crosscheck=flag,
+                    )
+                except Exception:
+                    # a handle must never dangle: degrade the cohort to
+                    # unknown (callers treat unknown as possibly-feasible)
+                    log.exception("coalesced solve flush failed; cohort of "
+                                  "%d degraded to unknown", len(entries))
+                    outcomes = [("unknown", None)] * len(entries)
+                for (handle, _c, _f), outcome in zip(entries, outcomes):
+                    handle._resolve(outcome)
 
     def clear(self) -> None:
         """Discard buffered state WITHOUT solving (clear_caches/test

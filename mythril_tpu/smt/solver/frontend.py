@@ -21,6 +21,7 @@ reference analysis/solver.py:217-257 exploit minimization).
 import time
 from typing import Dict, List, Optional, Tuple
 
+from mythril_tpu.observe.tracer import NULL_SPAN, span as trace_span
 from mythril_tpu.smt import terms
 from mythril_tpu.smt.bitblast import Blaster
 from mythril_tpu.smt.bitvec import Expression
@@ -448,15 +449,21 @@ class Solver:
                  objectives: List[Term] = ()) -> "_Prepared":
         """Simplify, lower, and blast the assertion set (+ objective bits).
         Timed into prepare_wall — the prepare component of the solver-wall
-        split (host settle and device dispatch are timed at their seams)."""
+        split (host settle and device dispatch are timed at their seams) —
+        and traced as the solver.prepare stage (the span's `mode` attr
+        distinguishes prefix resume from full pipeline from trivial)."""
         start = time.monotonic()
-        try:
-            return self._prepare_impl(extra, objectives)
-        finally:
-            SolverStatistics().add_prepare_seconds(time.monotonic() - start)
+        with trace_span("solver.prepare", cat="solver",
+                        constraints=len(self.constraints) + len(extra)) as sp:
+            try:
+                return self._prepare_impl(extra, objectives, sp)
+            finally:
+                SolverStatistics().add_prepare_seconds(
+                    time.monotonic() - start)
 
     def _prepare_impl(self, extra: List[Term],
-                      objectives: List[Term] = ()) -> "_Prepared":
+                      objectives: List[Term] = (),
+                      sp=NULL_SPAN) -> "_Prepared":
         from mythril_tpu.smt.solver import incremental
 
         prep = _Prepared()
@@ -483,6 +490,7 @@ class Solver:
         if resume is not None and resume.unsat:
             prep.trivial = UNSAT
             return prep
+        sp.set(mode="prefix_resume" if resume is not None else "full")
         if resume is not None:
             # path constraints grow monotonically: this query's list is a
             # memoized sibling's plus a suffix — the prefix's substitution
@@ -585,7 +593,10 @@ class Solver:
             if aig_opt.enabled():
                 roots = [prep.blaster.assert_bool(t) for t in lowered]
                 prep.blaster.last_roots = roots
-                opt = aig_opt.optimize_roots_cached(prep.blaster.aig, roots)
+                with trace_span("solver.aig_opt", cat="solver",
+                                roots=len(roots)):
+                    opt = aig_opt.optimize_roots_cached(
+                        prep.blaster.aig, roots)
                 if opt is not None:
                     prep.num_vars, prep.clauses, opt_dense = opt.aig.to_cnf(
                         list(opt.roots))
@@ -660,9 +671,11 @@ class Solver:
             device_possible = (
                 (_args.solver_backend == "tpu" and self.allow_device)
                 or aig_opted)
-            simplified = preprocess_cnf(
-                prep.num_vars, prep.clauses,
-                allow_pure=not objectives and not device_possible)
+            with trace_span("solver.cnf_prep", cat="solver",
+                            clauses=len(prep.clauses)):
+                simplified = preprocess_cnf(
+                    prep.num_vars, prep.clauses,
+                    allow_pure=not objectives and not device_possible)
             if simplified is not None and simplified.changed \
                     and not simplified.conflict:
                 SolverStatistics().add_cnf_preprocess(

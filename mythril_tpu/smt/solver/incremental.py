@@ -296,11 +296,16 @@ def try_resume(asserted) -> Optional[Resume]:
         return None
     stats = SolverStatistics()
     suffix = asserted[prefix_len:]
-    resume = _resume_from(snap, suffix)
-    if resume is None:
-        stats.add_prefix_fallback()
-        return None
-    stats.add_prefix_resume(len(suffix))
+    from mythril_tpu.observe.tracer import span as trace_span
+
+    with trace_span("solver.prefix_resume", cat="solver",
+                    prefix=prefix_len, suffix=len(suffix)) as sp:
+        resume = _resume_from(snap, suffix)
+        if resume is None:
+            sp.set(fallback=True)
+            stats.add_prefix_fallback()
+            return None
+        stats.add_prefix_resume(len(suffix))
     return resume
 
 

@@ -38,6 +38,11 @@ class SolverStatistics:
         # real host-CDCL solver invocations (counted at the sat_backend
         # terminal solve — the number every cache tier exists to shrink)
         "cdcl_settles",
+        # clause volume those terminal settles actually processed: the
+        # work numerator of the settle stage's roofline row
+        # (observe/roofline.py — attained clauses/s = cdcl_clauses /
+        # settle_wall, against the calibrated CDCL rate ceiling)
+        "cdcl_clauses",
         # static pre-analysis (mythril_tpu/preanalysis/): solver traffic
         # proven unnecessary before any solve — the SOLAR-style
         # "speed-of-light" denominator
@@ -96,6 +101,15 @@ class SolverStatistics:
         # stepping cost the frontier targets under solver noise. The
         # interpreter-side counterpart of prepare_wall in the wall split.
         "interp_wall",
+        # wall spent INSIDE terminal host-CDCL solves (session probes,
+        # native and python solvers alike) — the settle component of the
+        # roofline wall decomposition (observe/roofline.py). A subset of
+        # solver_time by construction.
+        "settle_wall",
+        # wall spent re-proving detection UNSATs on permuted instances
+        # (sat_backend._crosscheck_unsat) — soundness-net overhead,
+        # reported separately so it can never masquerade as settle cost
+        "crosscheck_wall",
     )
 
     def __new__(cls):
@@ -231,12 +245,23 @@ class SolverStatistics:
             self.window_flushes += 1
             self.coalesced_queries += queries
 
-    def add_cdcl_settle(self) -> None:
+    def add_cdcl_settle(self, clauses: int = 0,
+                        seconds: float = 0.0) -> None:
         """One real host-CDCL solver invocation (sat_backend terminal
         solve). Every cache tier exists to shrink this number; warm runs
-        must show strictly fewer than cold runs."""
+        must show strictly fewer than cold runs. `clauses` and `seconds`
+        feed the settle stage of the roofline (work and busy wall)."""
         if self.enabled:
             self.cdcl_settles += 1
+            self.cdcl_clauses += clauses
+            self.settle_wall += seconds
+
+    def add_crosscheck_seconds(self, seconds: float) -> None:
+        """Wall of one permuted-instance UNSAT re-solve (the detection
+        soundness net) — kept out of settle_wall so the roofline's settle
+        rate reflects verdict-producing work only."""
+        if self.enabled:
+            self.crosscheck_wall += seconds
 
     def add_module_gated(self, count: int = 1) -> None:
         """A detection module the static reachability gate skipped
@@ -440,8 +465,28 @@ class SolverStatistics:
         out["frontier_batch_occupancy"] = round(
             self.frontier_batch_occupancy, 4)
         out["prepare_suffix_hist"] = dict(self.prepare_suffix_hist)
+        # the FULL per-opcode histogram is what absorb() merges across
+        # --jobs workers (a top-10 slice silently dropped tail opcodes at
+        # every merge and skewed the parent's ranking); the _top view
+        # stays alongside as the human-facing shortlist
+        out["interp_opcode_wall"] = {
+            op: [count, round(seconds, 4)]
+            for op, (count, seconds) in self.interp_opcode_wall.items()}
         out["interp_opcode_wall_top"] = self.interp_opcode_wall_top()
         out["device"] = self.device_stats()
+        # speed-of-light accounting: per-stage attained vs attainable and
+        # the reconciled solver-wall decomposition (observe/roofline.py)
+        from mythril_tpu.observe import roofline
+
+        out["roofline"] = roofline.build(self)
+        # span-summary of the run's trace ({stage: [count, seconds]};
+        # empty unless MYTHRIL_TPU_TRACE / --trace enabled the tracer)
+        from mythril_tpu.observe.tracer import Tracer
+
+        tracer = Tracer._instance
+        out["trace_spans"] = (
+            tracer.summary() if tracer is not None and tracer.enabled
+            else {})
         return out
 
     def absorb(self, snapshot: dict) -> None:
@@ -460,11 +505,13 @@ class SolverStatistics:
                               or {}).items():
             self.prepare_suffix_hist[bucket] = (
                 self.prepare_suffix_hist.get(bucket, 0) + int(count))
-        # the snapshot carries the worker's TOP slice, not the full
-        # histogram — folding it in keeps the parent's ranking honest for
-        # the opcodes workers actually reported
-        for op, (count, seconds) in (snapshot.get("interp_opcode_wall_top")
-                                     or {}).items():
+        # merge the FULL per-opcode histogram; top-N slicing happens only
+        # at emission (interp_opcode_wall_top). Pre-fix snapshots carried
+        # only the top slice — accept it as a degraded fallback so mixed
+        # worker versions still merge what they reported.
+        histogram = (snapshot.get("interp_opcode_wall")
+                     or snapshot.get("interp_opcode_wall_top") or {})
+        for op, (count, seconds) in histogram.items():
             record = self.interp_opcode_wall.setdefault(op, [0, 0.0])
             record[0] += int(count)
             record[1] += float(seconds)
@@ -498,7 +545,9 @@ class SolverStatistics:
                     f" flushes ({self.coalesced_queries} queries,"
                     f" occupancy {self.coalesce_occupancy:.2f})")
         if self.cdcl_settles:
-            out += f", cdcl settles: {self.cdcl_settles}"
+            out += (f", cdcl settles: {self.cdcl_settles}"
+                    f" ({self.cdcl_clauses} clauses,"
+                    f" {self.settle_wall:.2f}s wall)")
         if self.modules_gated or self.queries_avoided \
                 or self.cnf_units_propagated or self.cnf_pure_literals \
                 or self.cnf_components_split:

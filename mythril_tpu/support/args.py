@@ -41,6 +41,8 @@ class _Args:
         self.beam_width = 8                    # --beam-search WIDTH
         self.transaction_sequences = None      # e.g. "[[0xa9059cbb],[-1]]"
         self.jobs = 1                          # corpus-parallel workers (-j)
+        self.trace = None                      # --trace PATH (span tracer
+        #   Perfetto export; MYTHRIL_TPU_TRACE is the env equivalent)
 
     def reset(self):
         self.__init__()

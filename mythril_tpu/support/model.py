@@ -20,6 +20,7 @@ from collections import OrderedDict, deque
 from contextlib import contextmanager
 from typing import Iterable, List, Optional
 
+from mythril_tpu.observe.tracer import span as trace_span
 from mythril_tpu.smt.bitvec import Expression
 from mythril_tpu.smt.model import Model
 from mythril_tpu.smt.solver import Optimize, Solver
@@ -243,7 +244,14 @@ def _probe_persistent(solver, prep, crosscheck, stats):
         return None, None
     from mythril_tpu.service.fingerprint import instance_fingerprint
 
-    fingerprint = instance_fingerprint(prep)
+    with trace_span("cache.probe", cat="service"):
+        return _probe_persistent_store(
+            store, instance_fingerprint(prep), solver, prep, crosscheck,
+            stats)
+
+
+def _probe_persistent_store(store, fingerprint, solver, prep, crosscheck,
+                            stats):
     if fingerprint is None:
         return None, None
     entry = store.lookup(fingerprint)
@@ -321,6 +329,19 @@ def get_model(
     solver_timeout: Optional[int] = None,
 ) -> Model:
     """Solve `constraints` (list of Bool); returns a validated Model."""
+    with trace_span("solver.get_model", cat="solver",
+                    constraints=len(constraints)):
+        return _get_model_impl(constraints, minimize, maximize,
+                               enforce_execution_time, solver_timeout)
+
+
+def _get_model_impl(
+    constraints,
+    minimize: Iterable = (),
+    maximize: Iterable = (),
+    enforce_execution_time: bool = True,
+    solver_timeout: Optional[int] = None,
+) -> Model:
     minimize, maximize = tuple(minimize), tuple(maximize)
     raw_constraints: List = [
         c.raw if isinstance(c, Expression) else c for c in constraints
@@ -456,6 +477,19 @@ def get_models_batch(
     the CDCL settling pass (None = follow the ambient detection context,
     same policy as get_model).
     """
+    with trace_span("solver.batch", cat="solver",
+                    queries=len(constraint_sets)):
+        return _get_models_batch_impl(constraint_sets,
+                                      enforce_execution_time,
+                                      solver_timeout, crosscheck)
+
+
+def _get_models_batch_impl(
+    constraint_sets,
+    enforce_execution_time: bool = True,
+    solver_timeout: Optional[int] = None,
+    crosscheck: Optional[bool] = None,
+) -> List:
     from mythril_tpu.smt.solver.frontend import Solver
 
     stats = SolverStatistics()

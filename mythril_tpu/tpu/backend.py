@@ -26,6 +26,8 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from mythril_tpu.observe.tracer import span as trace_span
+
 log = logging.getLogger(__name__)
 
 
@@ -162,6 +164,14 @@ class DeviceSolverBackend:
         self.pack_hits = 0
         self.pack_misses = 0
         self.flips = 0
+        # roofline work units (observe/roofline.py): bytes levelized into
+        # packed tensors (pack misses only — hits do no pack work), bytes
+        # actually uploaded to the device (padded-cache misses), and cells
+        # resimulated by kernel rounds (q x steps x 2 x levels x width,
+        # the same sim+walk cell unit the micro-calibration times)
+        self.pack_bytes = 0
+        self.ship_bytes = 0
+        self.cells_stepped = 0
         self._jax = None
         self._seed = 0
         self._pack_cache = _LRU(512)        # struct key -> PackedCircuit
@@ -274,16 +284,27 @@ class DeviceSolverBackend:
         """Levelize one root cone through the pack cache (no pre-pack
         var-cap shortcut — component sub-cones are smaller than their
         parent query's num_vars, so the caller applies caps on the packed
-        result instead)."""
+        result instead). Misses time their levelization into pack_seconds
+        HERE — the seam where pack work actually happens (the router packs
+        ahead of the batch call via packed_hint, so timing only the batch
+        loop under-reported the pack wall its byte volume was counted
+        against)."""
         from mythril_tpu.tpu import circuit
 
         skey = _circuit_struct_key(aig, roots)
-        pc, hit = self._pack_cache.get_or(
-            skey, lambda: circuit.PackedCircuit(aig, roots))
+
+        def _build():
+            start = time.monotonic()
+            pc = circuit.PackedCircuit(aig, roots)
+            self.pack_seconds += time.monotonic() - start
+            return pc
+
+        pc, hit = self._pack_cache.get_or(skey, _build)
         if hit:
             self.pack_hits += 1
         else:
             self.pack_misses += 1
+            self.pack_bytes += pc.nbytes
         return pc
 
     def padded_query_slots(self, n: int, single_device: bool = False) -> int:
@@ -364,33 +385,36 @@ class DeviceSolverBackend:
         else:
             level_cap, cell_cap, v1_cap = self._platform_caps(jax, circuit)
 
-        pack_start = time.monotonic()
         # entries: (orig idx, num_vars, pc, struct key, dense map or None)
+        # (pack wall accrues per-miss inside pack_cone; the loop here is
+        # cache lookups + cap checks)
         packed: List[Tuple[int, int, object, object, object]] = []
-        for qi, (num_vars, clauses, aig_roots) in enumerate(problems):
-            if num_vars == 0:
-                continue
-            if packed_hint is not None and packed_hint[qi] is not None:
-                pc = packed_hint[qi]
-            else:
-                pc = self.pack_problem(
-                    (num_vars, clauses, aig_roots), v1_cap)
-                if pc is None:
+        with trace_span("device.pack", cat="device",
+                        queries=len(problems)):
+            for qi, (num_vars, clauses, aig_roots) in enumerate(problems):
+                if num_vars == 0:
                     continue
-            # (aig, roots) or (aig, roots, dense_of_global) — dense maps the
-            # shared AIG's var ids onto the problem's compact CNF numbering
-            dense = aig_roots[2] if len(aig_roots) > 2 else None
-            skey = _circuit_struct_key(aig_roots[0], aig_roots[1])
-            if (
-                pc.ok
-                and pc.num_levels <= level_cap
-                and pc.num_levels * pc.max_width <= cell_cap
-                and pc.v1 <= v1_cap
-            ):
-                packed.append((qi, num_vars, pc, skey, dense))
-            elif pc.ok:
-                self.count_cap_reject()
-        self.pack_seconds += time.monotonic() - pack_start
+                if packed_hint is not None and packed_hint[qi] is not None:
+                    pc = packed_hint[qi]
+                else:
+                    pc = self.pack_problem(
+                        (num_vars, clauses, aig_roots), v1_cap)
+                    if pc is None:
+                        continue
+                # (aig, roots) or (aig, roots, dense_of_global) — dense
+                # maps the shared AIG's var ids onto the problem's compact
+                # CNF numbering
+                dense = aig_roots[2] if len(aig_roots) > 2 else None
+                skey = _circuit_struct_key(aig_roots[0], aig_roots[1])
+                if (
+                    pc.ok
+                    and pc.num_levels <= level_cap
+                    and pc.num_levels * pc.max_width <= cell_cap
+                    and pc.v1 <= v1_cap
+                ):
+                    packed.append((qi, num_vars, pc, skey, dense))
+                elif pc.ok:
+                    self.count_cap_reject()
         if not packed:
             return results
         call_start = time.monotonic()
@@ -424,34 +448,47 @@ class DeviceSolverBackend:
 
         q = _pow2_slots(dp, len(packed))
 
-        ship_start = time.monotonic()
         shape_key = (n_levels, width, v1, n_roots)
 
         def _padded_device(p, skey):
-            entry, _hit = self._padded_cache.get_or(
-                (skey, shape_key),
-                lambda: {k: jnp.asarray(v)
-                         for k, v in p.padded_to(*shape_key).items()},
-            )
+            # ship work AND wall both accrue per MISS (matching pack's
+            # per-miss accrual): only misses pad + upload, so timing the
+            # whole assembly while counting miss bytes made warm runs
+            # report their entire ship wall as recoverable gap
+            def _upload():
+                start = time.monotonic()
+                entry = {k: jnp.asarray(v)
+                         for k, v in p.padded_to(*shape_key).items()}
+                self.ship_seconds += time.monotonic() - start
+                return entry
+
+            entry, hit = self._padded_cache.get_or(
+                (skey, shape_key), _upload)
+            if not hit:
+                self.ship_bytes += int(sum(v.nbytes
+                                           for v in entry.values()))
             return entry
 
-        padded = [_padded_device(p, skey) for _, _, p, skey, _ in packed]
-        # query-axis padding: zero tensors have no live roots, so padding
-        # slots report found at step 0 and stay frozen
-        if q > len(packed):
-            zero, _ = self._padded_cache.get_or(
-                ("zero", shape_key),
-                lambda: {k: jnp.zeros_like(padded[0][k])
-                         for k in circuit.TENSOR_KEYS},
-            )
-            padded = padded + [zero] * (q - len(packed))
-        # stacking resident per-circuit tensors happens on device — only
-        # cache misses paid a host->device transfer above
-        tensors = {
-            k: jnp.stack([entry[k] for entry in padded])
-            for k in circuit.TENSOR_KEYS
-        }
-        self.ship_seconds += time.monotonic() - ship_start
+        with trace_span("device.ship", cat="device", slots=q):
+            padded = [_padded_device(p, skey)
+                      for _, _, p, skey, _ in packed]
+            # query-axis padding: zero tensors have no live roots, so
+            # padding slots report found at step 0 and stay frozen
+            if q > len(packed):
+                zero, _ = self._padded_cache.get_or(
+                    ("zero", shape_key),
+                    lambda: {k: jnp.zeros_like(padded[0][k])
+                             for k in circuit.TENSOR_KEYS},
+                )
+                padded = padded + [zero] * (q - len(packed))
+            # stacking resident per-circuit tensors happens on device —
+            # only cache misses paid a host->device transfer above (the
+            # stack itself is batch assembly, timed by the span but not
+            # charged to the ship transfer rate)
+            tensors = {
+                k: jnp.stack([entry[k] for entry in padded])
+                for k in circuit.TENSOR_KEYS
+            }
         solve_start = time.monotonic()  # solve phase excludes pack + ship
 
         key = jax.random.PRNGKey(self._seed)
@@ -475,47 +512,56 @@ class DeviceSolverBackend:
         best_rows = {}  # slot -> host copy of the satisfying assignment
         rounds = 0
         stall = 0
-        while True:
-            if multi:
-                x, found, _solved_dev = round_fn(tensors, x, keys)
-            else:
-                x, found = circuit.run_round_circuit_batch(
-                    tensors, x, keys, steps=steps,
-                    walk_depth=walk_depth)
-            rounds += 1
-            self.flips += q * num_restarts * steps
-            found_host = np.asarray(found)
-            round_solved = found_host.any(axis=1)
-            newly = round_solved & ~solved
-            if newly.any():
-                stall = 0
-                x_host = np.asarray(x)
-                for slot in np.nonzero(newly)[0]:
-                    row = int(np.argmax(found_host[slot]))
-                    best_rows[int(slot)] = x_host[slot, row].copy()
-            else:
-                stall += 1
-            solved |= round_solved
-            if (solved.all() or stall >= self.STALL_ROUNDS
-                    or time.monotonic() >= deadline):
-                break
-            keys = jax.vmap(jax.random.fold_in)(
-                keys,
-                jnp.full((q,), rounds, dtype=jnp.uint32),
-            )
-            # re-randomize UNSOLVED queries' stale half for diversification
-            # (solved slots keep their frozen assignments)
-            key, re_key = jax.random.split(key)
-            fresh = jax.random.bernoulli(
-                re_key, 0.5, x.shape).astype(jnp.int32)
-            half = num_restarts // 2
-            if half:
-                unsolved = jnp.asarray(
-                    (~solved).astype(np.int32))[:, None, None]
-                x = x.at[:, :half].set(
-                    x[:, :half] * (1 - unsolved)
-                    + fresh[:, :half] * unsolved
+        with trace_span("device.kernel", cat="device", slots=q,
+                        levels=n_levels, width=width,
+                        restarts=num_restarts) as kernel_span:
+            while True:
+                if multi:
+                    x, found, _solved_dev = round_fn(tensors, x, keys)
+                else:
+                    x, found = circuit.run_round_circuit_batch(
+                        tensors, x, keys, steps=steps,
+                        walk_depth=walk_depth)
+                rounds += 1
+                self.flips += q * num_restarts * steps
+                # kernel roofline work: each step resimulates levels x
+                # width cells plus a comparable-depth walk (the 2x) per
+                # padded query slot — the same cell unit per_cell_s times
+                self.cells_stepped += q * steps * 2 * n_levels * width
+                found_host = np.asarray(found)
+                round_solved = found_host.any(axis=1)
+                newly = round_solved & ~solved
+                if newly.any():
+                    stall = 0
+                    x_host = np.asarray(x)
+                    for slot in np.nonzero(newly)[0]:
+                        row = int(np.argmax(found_host[slot]))
+                        best_rows[int(slot)] = x_host[slot, row].copy()
+                else:
+                    stall += 1
+                solved |= round_solved
+                if (solved.all() or stall >= self.STALL_ROUNDS
+                        or time.monotonic() >= deadline):
+                    break
+                keys = jax.vmap(jax.random.fold_in)(
+                    keys,
+                    jnp.full((q,), rounds, dtype=jnp.uint32),
                 )
+                # re-randomize UNSOLVED queries' stale half for
+                # diversification (solved slots keep their frozen
+                # assignments)
+                key, re_key = jax.random.split(key)
+                fresh = jax.random.bernoulli(
+                    re_key, 0.5, x.shape).astype(jnp.int32)
+                half = num_restarts // 2
+                if half:
+                    unsolved = jnp.asarray(
+                        (~solved).astype(np.int32))[:, None, None]
+                    x = x.at[:, :half].set(
+                        x[:, :half] * (1 - unsolved)
+                        + fresh[:, :half] * unsolved
+                    )
+            kernel_span.set(rounds=rounds)
 
         for slot, (qi, num_vars, p, _skey, dense) in enumerate(packed):
             assignment = best_rows.get(slot)
@@ -580,6 +626,9 @@ class DeviceSolverBackend:
             "cap_rejects": self.cap_rejects,
             "pack_hits": self.pack_hits,
             "pack_misses": self.pack_misses,
+            "pack_bytes": self.pack_bytes,
+            "ship_bytes": self.ship_bytes,
+            "cells_stepped": self.cells_stepped,
             "pack_seconds": round(self.pack_seconds, 4),
             "ship_seconds": round(self.ship_seconds, 4),
             "solve_seconds": round(self.solve_seconds, 4),

@@ -159,6 +159,14 @@ class PackedCircuit:
         )
         self.ok = True
 
+    @property
+    def nbytes(self) -> int:
+        """Total bytes of the packed (unpadded) tensors — the pack stage's
+        work unit for roofline accounting (observe/roofline.py)."""
+        if not self.ok:
+            return 0
+        return int(sum(getattr(self, key).nbytes for key in TENSOR_KEYS))
+
     @classmethod
     def from_component(cls, aig, component) -> "PackedCircuit":
         """Construct-from-subgraph path: pack one partitioned sub-cone

@@ -1,0 +1,425 @@
+"""Observability layer: span tracer, Perfetto export, roofline accounting.
+
+Covers the PR-7 acceptance surface that tier-1 can check without a stress
+run: Chrome-trace schema validity (required ph/ts/dur/pid/tid/name fields,
+proper X-event nesting per thread lane), the spans-sum-to-wall
+reconciliation property (both for the tracer's own hierarchy and for the
+roofline wall decomposition), the --jobs histogram merge regression
+(SolverStatistics.absorb must fold the FULL per-opcode histogram, not the
+top-10 slice), the telemetry-survives-crash guarantee (stats JSON written
+from the finally with completed=false), and the disabled-mode overhead
+guard (the tracer must stay under 2% of a stress-run wall when off, which
+at the measured span-site density means single-digit microseconds per
+crossed site)."""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from mythril_tpu.observe import get_tracer, span, traced
+from mythril_tpu.observe import roofline
+from mythril_tpu.observe.tracer import NULL_SPAN
+from mythril_tpu.smt.solver.statistics import SolverStatistics
+from mythril_tpu.support.args import args
+
+
+@pytest.fixture(autouse=True)
+def fresh_observability_state():
+    stats = SolverStatistics()
+    stats.reset()
+    stats.enabled = True
+    tracer = get_tracer()
+    tracer.reset()
+    yield
+    tracer.reset()
+    stats.reset()
+    args.trace = None
+
+
+# -- trace export schema ------------------------------------------------------
+
+
+def _busy(loops=2000):
+    total = 0
+    for i in range(loops):
+        total += i
+    return total
+
+
+def test_trace_export_schema_and_nesting(tmp_path):
+    """The emitted JSON must be a valid Chrome trace: every X event
+    carries ph/ts/dur/pid/tid/name, and X events on one (pid, tid) lane
+    are properly nested (disjoint or contained — Perfetto renders the
+    hierarchy purely from containment)."""
+    tracer = get_tracer()
+    path = str(tmp_path / "trace.json")
+    tracer.enable(path)
+
+    def worker():
+        with span("worker.outer", cat="test"):
+            with span("worker.inner", cat="test"):
+                _busy()
+
+    thread = threading.Thread(target=worker)
+    with span("main.outer", cat="test", queries=3) as sp:
+        with span("main.inner", cat="test"):
+            _busy()
+        with span("main.inner", cat="test"):
+            _busy()
+        sp.set(done=True)
+    thread.start()
+    thread.join()
+    assert tracer.write() == path
+
+    payload = json.load(open(path))
+    events = payload["traceEvents"]
+    x_events = [e for e in events if e["ph"] == "X"]
+    assert len(x_events) == 5
+    for event in x_events:
+        for field in ("name", "ph", "ts", "dur", "pid", "tid"):
+            assert field in event, f"missing {field}: {event}"
+        assert event["ts"] >= 0 and event["dur"] >= 0
+    # metadata names every pid lane
+    assert any(e["ph"] == "M" and e["name"] == "process_name"
+               for e in events)
+    # args attached mid-span survive export
+    outer = next(e for e in x_events if e["name"] == "main.outer")
+    assert outer["args"] == {"queries": 3, "done": True}
+
+    # nesting: within one lane, any two spans are disjoint or contained
+    lanes = {}
+    for event in x_events:
+        lanes.setdefault((event["pid"], event["tid"]), []).append(event)
+    assert len(lanes) == 2  # main thread + worker thread
+    eps = 0.01  # µs rounding slack
+    for lane in lanes.values():
+        for a in lane:
+            for b in lane:
+                if a is b:
+                    continue
+                a0, a1 = a["ts"], a["ts"] + a["dur"]
+                b0, b1 = b["ts"], b["ts"] + b["dur"]
+                disjoint = a1 <= b0 + eps or b1 <= a0 + eps
+                a_in_b = a0 >= b0 - eps and a1 <= b1 + eps
+                b_in_a = b0 >= a0 - eps and b1 <= a1 + eps
+                assert disjoint or a_in_b or b_in_a, (a, b)
+
+
+def test_spans_sum_to_wall_reconciliation(tmp_path):
+    """Property: on one thread lane, child span durations can never
+    exceed their parent's, and the top-level spans can never exceed the
+    measured wall of the traced region — the invariant that makes the
+    trace a trustworthy wall decomposition."""
+    tracer = get_tracer()
+    tracer.enable(str(tmp_path / "t.json"))
+    wall_start = time.perf_counter()
+    with span("root", cat="test"):
+        for _ in range(10):
+            with span("child", cat="test"):
+                with span("grandchild", cat="test"):
+                    _busy(500)
+    wall = (time.perf_counter() - wall_start) * 1e6
+    events = tracer.drain_events()
+    by_name = {}
+    for event in events:
+        by_name.setdefault(event["name"], []).append(event)
+    root = by_name["root"][0]
+    child_total = sum(e["dur"] for e in by_name["child"])
+    grand_total = sum(e["dur"] for e in by_name["grandchild"])
+    eps = len(events) * 0.01
+    assert grand_total <= child_total + eps
+    assert child_total <= root["dur"] + eps
+    assert root["dur"] <= wall + eps
+
+
+# -- roofline accounting ------------------------------------------------------
+
+
+def test_roofline_wall_decomposition_reconciles():
+    """The wall decomposition's named components plus the explicit
+    residual must sum to the measured solver wall (the acceptance
+    criterion's 5% reconciliation, here exact by construction), and the
+    independently-measured components must never exceed the total."""
+    stats = SolverStatistics()
+    stats.add_prepare_seconds(0.8)
+    stats.add_cdcl_settle(clauses=120_000, seconds=0.5)
+    stats.add_crosscheck_seconds(0.1)
+    stats.add_device_dispatch(queries=2, slots=2, seconds=0.4)
+    stats.add_query(2.5)  # total solver wall
+
+    report = roofline.build(stats)
+    wall = report["wall"]
+    total = wall["solver_total_s"]
+    named = (wall["prepare_s"] + wall["settle_s"] + wall["crosscheck_s"]
+             + wall["device_s"])
+    assert named <= total * 1.05, "components over-count the wall"
+    assert named + wall["other_s"] == pytest.approx(total, abs=1e-3)
+    assert 0.0 <= wall["attributed_frac"] <= 1.0
+
+    stages = report["stages"]
+    assert set(stages) == set(roofline.STAGES)
+    settle = stages["settle"]
+    assert settle["work"] == 120_000
+    assert settle["attained"] == pytest.approx(240_000, rel=0.01)
+
+
+def test_roofline_emitted_in_stats_json_and_gap_ranking():
+    stats = SolverStatistics()
+    stats.add_cdcl_settle(clauses=1000, seconds=0.25)
+    out = stats.as_dict()
+    assert set(out["roofline"]["stages"]) == set(roofline.STAGES)
+    assert "trace_spans" in out
+    # ranking: stages without a ceiling rank after stages with a gap
+    fake = {"stages": {
+        "pack": {"sol_gap_s": 0.5, "attained": 1, "attainable": 2,
+                 "units": "bytes/s"},
+        "ship": {"sol_gap_s": None, "attained": 1, "attainable": None,
+                 "units": "bytes/s"},
+        "kernel": {"sol_gap_s": 2.0, "attained": 1, "attainable": 9,
+                   "units": "cells/s"},
+        "settle": {"sol_gap_s": 0.1, "attained": 1, "attainable": 2,
+                   "units": "clauses/s"},
+    }}
+    top = roofline.top_gaps(fake, n=3)
+    assert [row["stage"] for row in top] == ["kernel", "pack", "settle"]
+
+
+def test_calibration_profile_persists_stage_rates(tmp_path, monkeypatch):
+    """The persisted micro-calibration entry carries the stage ceilings
+    beside per_cell_s; old entries without them still load (per_cell only)
+    and corrupt rates are dropped."""
+    from mythril_tpu.service.calibration import (
+        load_per_cell_latency,
+        load_profile,
+        save_profile,
+    )
+
+    monkeypatch.setenv("MYTHRIL_TPU_CACHE_DIR", str(tmp_path))
+    args.solve_cache = "disk"
+    try:
+        save_profile("cpu", 8, 32, {
+            "per_cell_s": 5e-8,
+            "pack_bytes_s": 2e8,
+            "ship_bytes_s": 5e8,
+            "settle_clauses_s": 3e6,
+            "bogus_rate_s": -1,
+        })
+        profile = load_profile("cpu", 8, 32)
+        assert profile["per_cell_s"] == pytest.approx(5e-8)
+        assert profile["pack_bytes_s"] == pytest.approx(2e8)
+        assert profile["settle_clauses_s"] == pytest.approx(3e6)
+        assert "bogus_rate_s" not in profile
+        # back-compat wrapper still answers
+        assert load_per_cell_latency("cpu", 8, 32) == pytest.approx(5e-8)
+        # per_cell-only entry (pre-PR-7 cache): loads without stage rates
+        save_profile("cpu", 16, 32, {"per_cell_s": 7e-8})
+        old = load_profile("cpu", 16, 32)
+        assert old == {"per_cell_s": pytest.approx(7e-8)}
+    finally:
+        args.solve_cache = "memory"
+
+
+def test_stale_calibration_entry_still_gains_stage_ceilings(
+        tmp_path, monkeypatch):
+    """A pre-roofline calibration entry (per_cell_s only, no TTL) must
+    not suppress stage-rate measurement forever: the cache-hit path
+    measures the missing rates (no kernel round) and re-persists them."""
+    from mythril_tpu.service.calibration import load_profile, save_profile
+    from mythril_tpu.tpu import router as router_mod
+
+    monkeypatch.setenv("MYTHRIL_TPU_CACHE_DIR", str(tmp_path))
+    args.solve_cache = "disk"
+    router_mod.reset_router()
+    try:
+        router = router_mod.get_router()
+        platform = router._platform()
+        if platform is None:
+            pytest.skip("jax unavailable")
+        save_profile(platform, router._profile_restarts(),
+                     router._profile_steps(), {"per_cell_s": 7e-8})
+        measured = {"pack_bytes_s": 1e8, "ship_bytes_s": 2e8,
+                    "settle_clauses_s": 3e6}
+        monkeypatch.setattr(
+            router_mod.QueryRouter, "_measure_round_latency",
+            lambda self: pytest.fail("kernel round must stay skipped"))
+        monkeypatch.setattr(
+            router_mod.QueryRouter, "_measure_stage_rates_fresh",
+            lambda self: dict(measured))
+        assert router._calibrate() is True
+        assert router._per_cell_s == pytest.approx(7e-8)
+        assert router.attainable_rates()["pack_bytes_s"] == 1e8
+        # re-persisted: the NEXT process loads the rates from disk
+        stored = load_profile(platform, router._profile_restarts(),
+                              router._profile_steps())
+        assert stored["settle_clauses_s"] == pytest.approx(3e6)
+    finally:
+        router_mod.reset_router()
+        args.solve_cache = "memory"
+
+
+# -- --jobs histogram merge regression ---------------------------------------
+
+
+def test_absorb_merges_full_opcode_histogram():
+    """absorb() must fold the FULL interp_opcode_wall histogram from a
+    worker snapshot — the old code read the top-10 slice and silently
+    dropped every tail opcode at each --jobs merge."""
+    worker = SolverStatistics()
+    worker.reset()
+    worker.enabled = True
+    for i in range(15):
+        worker.add_interp_opcode_wall(f"OP{i:02d}", 0.001 * (15 - i))
+    snapshot = worker.as_dict()
+    assert len(snapshot["interp_opcode_wall"]) == 15
+    assert len(snapshot["interp_opcode_wall_top"]) == 10
+
+    parent = SolverStatistics()
+    parent.reset()
+    parent.enabled = True
+    parent.add_interp_opcode_wall("OP14", 0.5)  # overlaps worker's tail
+    parent.absorb(snapshot)
+    assert len(parent.interp_opcode_wall) == 15, (
+        "tail opcodes were dropped in the --jobs merge")
+    count, seconds = parent.interp_opcode_wall["OP14"]
+    assert count == 2
+    assert seconds == pytest.approx(0.501, rel=0.01)
+    # a second worker merges on top without loss
+    parent.absorb(snapshot)
+    assert parent.interp_opcode_wall["OP00"][0] == 2
+    # degraded fallback: ancient snapshots with only the top slice
+    parent2 = SolverStatistics()
+    parent2.reset()
+    parent2.enabled = True
+    parent2.absorb({"interp_opcode_wall_top": {"PUSH1": [3, 0.1]}})
+    assert parent2.interp_opcode_wall["PUSH1"] == [3, 0.1]
+
+
+# -- telemetry survives a crashed run ----------------------------------------
+
+
+def test_stats_json_written_from_finally_on_module_exception(
+        tmp_path, monkeypatch):
+    """A module exception escaping the per-contract capture must no
+    longer lose the run's telemetry: the stats JSON (tagged
+    completed=false) and the trace are written from the finally."""
+    from mythril_tpu.core import MythrilAnalyzer, MythrilDisassembler
+
+    stats_path = str(tmp_path / "stats.json")
+    trace_path = str(tmp_path / "trace.json")
+    monkeypatch.setenv("MYTHRIL_TPU_STATS_JSON", stats_path)
+    monkeypatch.setenv("MYTHRIL_TPU_TRACE", trace_path)
+    disassembler = MythrilDisassembler()
+    disassembler.load_from_bytecode("0x600035600055600056",
+                                    bin_runtime=True)
+    analyzer = MythrilAnalyzer(disassembler, strategy="bfs")
+    monkeypatch.setattr(
+        MythrilAnalyzer, "_analyze_one_contract",
+        lambda *a, **k: (_ for _ in ()).throw(RuntimeError("boom")))
+    with pytest.raises(RuntimeError):
+        analyzer.fire_lasers(transaction_count=1)
+    payload = json.load(open(stats_path))
+    assert payload["completed"] is False
+    assert "roofline" in payload
+    assert os.path.exists(trace_path)
+
+
+def test_tiny_analyze_trace_covers_laser_layer(tmp_path, monkeypatch):
+    """End-to-end: a real (tiny) analyze with tracing on produces a valid
+    trace covering the analyze/laser layer and a completed=true stats
+    dump — the tier-1 slice of the stress-leg acceptance check."""
+    from mythril_tpu.core import MythrilAnalyzer, MythrilDisassembler
+
+    stats_path = str(tmp_path / "stats.json")
+    trace_path = str(tmp_path / "trace.json")
+    monkeypatch.setenv("MYTHRIL_TPU_STATS_JSON", stats_path)
+    monkeypatch.setenv("MYTHRIL_TPU_TRACE", trace_path)
+    saved_timeout = args.execution_timeout
+    args.execution_timeout = 60
+    try:
+        disassembler = MythrilDisassembler()
+        disassembler.load_from_bytecode("0x600035600055600056",
+                                        bin_runtime=True)
+        analyzer = MythrilAnalyzer(disassembler, strategy="bfs")
+        analyzer.fire_lasers(transaction_count=1)
+    finally:
+        args.execution_timeout = saved_timeout
+    trace = json.load(open(trace_path))
+    names = {e["name"] for e in trace["traceEvents"] if e["ph"] == "X"}
+    assert {"analyze.contract", "laser.exec"} <= names
+    payload = json.load(open(stats_path))
+    assert payload["completed"] is True
+    assert set(payload["trace_spans"]) == names
+
+
+def test_solver_layer_spans_at_the_batch_seam(tmp_path):
+    """The solver layer's stages appear in a traced get_models_batch
+    (host path — no jit): with the laser-layer names from the analyze
+    test, the two layers together cover the >=8-stage acceptance shape."""
+    from mythril_tpu.smt import symbol_factory
+    from mythril_tpu.support import model as model_mod
+    from mythril_tpu.support.model import get_models_batch
+
+    model_mod.clear_caches()
+    tracer = get_tracer()
+    tracer.enable(str(tmp_path / "t.json"))
+    x = symbol_factory.BitVecSym("obs_x", 64)
+    y = symbol_factory.BitVecSym("obs_y", 64)
+    outcomes = get_models_batch([
+        [x + y == symbol_factory.BitVecVal(99, 64),
+         x > symbol_factory.BitVecVal(3, 64)],
+        [y == symbol_factory.BitVecVal(0, 64),
+         y == symbol_factory.BitVecVal(1, 64)],
+    ])
+    assert outcomes[0][0] == "sat"
+    names = set(tracer.summary())
+    assert {"solver.batch", "solver.prepare", "solver.settle"} <= names
+
+
+# -- args / CLI plumbing ------------------------------------------------------
+
+
+def test_trace_arg_flows_into_global_args():
+    from mythril_tpu.core import MythrilAnalyzer, MythrilDisassembler
+
+    class _Ns:
+        trace = "/tmp/some_trace.json"
+
+    disassembler = MythrilDisassembler()
+    disassembler.load_from_bytecode("0x6000", bin_runtime=True)
+    MythrilAnalyzer(disassembler, cmd_args=_Ns())
+    assert args.trace == "/tmp/some_trace.json"
+
+
+# -- disabled-mode overhead guard --------------------------------------------
+
+
+def test_disabled_tracer_overhead_under_budget():
+    """Tier-1 guard for the <2% disabled-mode overhead bound: a stress
+    analyze leg crosses span sites on the order of 1e5 times over a
+    ~100 s wall, so 2% of wall budgets ~20 µs per crossing. The disabled
+    path must be one shared object with no allocation — assert identity
+    and a generous 10 µs/crossing ceiling (an accidental always-on
+    tracer measures hundreds of µs: lock + dict + list append)."""
+    tracer = get_tracer()
+    tracer.reset()  # disabled
+    assert span("anything", cat="x") is NULL_SPAN
+    assert span("anything") is span("other")  # no allocation
+
+    @traced("decorated.stage")
+    def tiny():
+        return 1
+
+    n = 50_000
+    start = time.perf_counter()
+    for _ in range(n):
+        with span("hot.site", cat="x", attr=1):
+            pass
+        tiny()
+    per_crossing_us = (time.perf_counter() - start) * 1e6 / (2 * n)
+    assert per_crossing_us < 10.0, (
+        f"disabled tracer costs {per_crossing_us:.2f}µs per span site — "
+        "over the 2%-of-stress-wall budget")
+    assert tracer.drain_events() == []  # nothing was recorded

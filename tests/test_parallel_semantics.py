@@ -57,11 +57,15 @@ def _analyzer(n_contracts):
 
 @pytest.fixture(autouse=True)
 def fresh_stats():
+    from mythril_tpu.observe import get_tracer
+
     stats = SolverStatistics()
     stats.reset()
     stats.enabled = True
+    get_tracer().reset()
     saved_jobs = args.jobs
     yield
+    get_tracer().reset()
     stats.reset()
     args.jobs = saved_jobs
 
@@ -77,8 +81,10 @@ def test_worker_failure_reruns_only_incomplete(monkeypatch):
     # workers finish contracts 0 and 2 (out of order), then the pool dies
     pool = ScriptedPool(
         results=[
-            (2, ["issue-c2"], [], {"query_count": 7}),
-            (0, ["issue-c0"], ["boom-c0"], {"query_count": 5}),
+            (2, ["issue-c2"], [], {"query_count": 7}, []),
+            (0, ["issue-c0"], ["boom-c0"], {"query_count": 5},
+             [{"name": "laser.exec", "cat": "laser", "ph": "X", "ts": 0.0,
+               "dur": 5.0, "pid": 4242, "tid": 1}]),
         ],
         error=RuntimeError("worker lost"),
     )
@@ -97,13 +103,19 @@ def test_worker_failure_reruns_only_incomplete(monkeypatch):
     assert exceptions == ["boom-c0"]
     # per-worker statistics aggregated into the parent singleton
     assert SolverStatistics().query_count == 12
+    # worker trace spans merged into the parent tracer, pid lane intact
+    from mythril_tpu.observe import get_tracer
+
+    merged = get_tracer().drain_events()
+    assert any(e["pid"] == 4242 and e["name"] == "laser.exec"
+               for e in merged)
 
 
 def test_keyboard_interrupt_keeps_completed_work(monkeypatch):
     args.jobs = 2
     analyzer = _analyzer(3)
     pool = ScriptedPool(
-        results=[(1, ["issue-c1"], [], {})],
+        results=[(1, ["issue-c1"], [], {}, [])],
         error=KeyboardInterrupt(),
     )
     _patch_pool(monkeypatch, pool)
@@ -128,8 +140,8 @@ def test_clean_run_keeps_contract_order(monkeypatch):
     analyzer = _analyzer(2)
     pool = ScriptedPool(
         results=[
-            (1, ["issue-c1"], [], {}),
-            (0, ["issue-c0"], [], {}),
+            (1, ["issue-c1"], [], {}, []),
+            (0, ["issue-c0"], [], {}, []),
         ],
     )
     _patch_pool(monkeypatch, pool)

@@ -2,7 +2,7 @@
 """Repo lint: every SolverStatistics counter must be emitted everywhere
 telemetry is consumed (mirrors tools/check_env_docs.py for env vars).
 
-Three invariants, each of which has silently rotted before (bench rows
+Five invariants, each of which has silently rotted before (bench rows
 missing counters the JSON dump carried, so per-leg roll-ups under-reported
 what the run actually did):
 
@@ -12,7 +12,13 @@ what the run actually did):
      ROUTING_KEYS roll-up (one list drives the per-leg routing row, the
      corpus roll-up, and the summary);
   3. every ROUTING_KEYS stats_key names a real SolverStatistics field
-     (no stale keys silently reporting 0 forever).
+     (no stale keys silently reporting 0 forever);
+  4. the observability sections flow end to end: as_dict() must emit a
+     "roofline" section whose stage set equals observe.roofline.STAGES,
+     plus the "trace_spans" span summary;
+  5. bench.py's ROOFLINE_STAGES (the per-leg gap table) must mirror
+     observe.roofline.STAGES — a stage without a gap row is a ceiling
+     nobody sees.
 
 Exits 1 listing the violations. Wired into tier-1 via
 tests/test_stats_keys.py.
@@ -44,7 +50,8 @@ def main(argv) -> int:
     bench = _load_bench(root)
     fields = tuple(SolverStatistics._COUNTERS) + tuple(
         SolverStatistics._TIMERS)
-    emitted = set(SolverStatistics().as_dict())
+    emitted_dict = SolverStatistics().as_dict()
+    emitted = set(emitted_dict)
     routed = {stats_key for stats_key, _report_key in bench.ROUTING_KEYS}
 
     failures = []
@@ -67,6 +74,30 @@ def main(argv) -> int:
         failures.append(
             "bench.py ROUTING_KEYS references unknown SolverStatistics "
             "fields: " + ", ".join(stale))
+
+    # observability sections: roofline + span summary must flow through
+    # as_dict -> stats JSON -> bench's per-leg gap table
+    from mythril_tpu.observe import roofline
+
+    roofline_section = emitted_dict.get("roofline")
+    if not isinstance(roofline_section, dict):
+        failures.append(
+            "as_dict() does not emit the \"roofline\" section")
+    else:
+        stage_names = set(roofline_section.get("stages", {}))
+        if stage_names != set(roofline.STAGES):
+            failures.append(
+                "roofline stages emitted by as_dict() "
+                f"({sorted(stage_names)}) do not match "
+                f"observe.roofline.STAGES ({sorted(roofline.STAGES)})")
+    if "trace_spans" not in emitted_dict:
+        failures.append(
+            "as_dict() does not emit the \"trace_spans\" span summary")
+    bench_stages = tuple(getattr(bench, "ROOFLINE_STAGES", ()))
+    if bench_stages != tuple(roofline.STAGES):
+        failures.append(
+            f"bench.py ROOFLINE_STAGES {bench_stages} does not mirror "
+            f"observe.roofline.STAGES {tuple(roofline.STAGES)}")
 
     if failures:
         print("FAIL: SolverStatistics telemetry is not fully emitted:",
