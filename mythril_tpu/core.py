@@ -212,7 +212,7 @@ class MythrilAnalyzer:
                 "solve_cache", "transaction_sequences", "beam_width",
                 "disable_coverage_strategy", "jobs", "no_preanalysis",
                 "no_aig_opt", "no_incremental_prep", "no_vmap_frontier",
-                "trace",
+                "trace", "inject_fault",
             ):
                 if hasattr(cmd_args, field) and getattr(cmd_args, field) is not None:
                     setattr(args, field, getattr(cmd_args, field))
@@ -234,6 +234,12 @@ class MythrilAnalyzer:
             module.reset_cache()
         stats = SolverStatistics()
         stats.enabled = True
+        # fault-injection harness (resilience/faults.py): armed from
+        # MYTHRIL_TPU_FAULTS or --inject-fault, disarmed when neither is
+        # set — one configure per run so crossing counters start fresh
+        from mythril_tpu.resilience import faults
+
+        faults.configure_from_env(getattr(args, "inject_fault", None))
         trace_path = getattr(args, "trace", None) \
             or os.environ.get(TRACE_ENV)
         if trace_path:
@@ -369,7 +375,15 @@ class MythrilAnalyzer:
         pool.map was all-or-nothing: one failure re-ran the WHOLE corpus
         sequentially, potentially doubling wall). Worker failures fall back
         to sequential analysis of ONLY the incomplete contracts; per-worker
-        SolverStatistics snapshots are folded into the parent singleton."""
+        SolverStatistics snapshots are folded into the parent singleton.
+
+        Worker DEATH (a killed/OOMed/crashed worker process, the
+        registered jobs.worker fault site) is detected by a liveness
+        watchdog while waiting on results — a lost task would otherwise
+        hang the imap iterator forever, since the pool silently respawns
+        the worker without resubmitting its work. The dead worker's
+        pending contracts are requeued into a FRESH pool once; a second
+        death degrades the rest to in-process sequential analysis."""
         import multiprocessing as mp
 
         workers = min(args.jobs, len(self.contracts))
@@ -386,21 +400,49 @@ class MythrilAnalyzer:
         done = {}  # contract idx -> (issues, exceptions)
         interrupted = False
         try:
-            with context.Pool(processes=workers) as pool:
-                for idx, issues, contract_exceptions, stats_snapshot, \
-                        trace_events in \
-                        pool.imap_unordered(_corpus_worker, payloads):
-                    done[idx] = (issues, contract_exceptions)
-                    stats.absorb(stats_snapshot)
-                    # worker spans carry their own pid: each worker gets
-                    # its own process lane in the merged timeline
-                    tracer.absorb_events(trace_events)
+            pending = payloads
+            requeued = False
+            while True:
+                try:
+                    self._consume_pool(context, workers, pending, done,
+                                       stats, tracer)
+                    break
+                except _PoolWorkerDied:
+                    from mythril_tpu import resilience
+
+                    pending = [p for p in payloads if p[0] not in done]
+                    if not pending:
+                        # the dead worker had nothing in flight (its
+                        # results were already consumed): the corpus is
+                        # complete, nothing degraded
+                        break
+                    if not requeued:
+                        requeued = True
+                        resilience.record_event(
+                            "jobs.worker", "worker_requeue", len(pending))
+                        log.warning(
+                            "a --jobs worker died; requeuing %d pending "
+                            "contract(s) into a fresh pool",
+                            len(pending))
+                        workers = min(workers, len(pending))
+                        continue
+                    # second death (or nothing left): the in-process
+                    # sequential completion below analyzes the rest
+                    resilience.record_event("jobs.worker", "degraded")
+                    log.warning(
+                        "worker died again after the requeue; analyzing "
+                        "the %d incomplete contract(s) in-process",
+                        len(pending))
+                    break
         except KeyboardInterrupt:
             interrupted = True
             log.critical(
                 "keyboard interrupt: keeping %d/%d completed contracts",
                 len(done), len(payloads))
         except Exception:
+            from mythril_tpu import resilience
+
+            resilience.record_event("jobs.worker", "degraded")
             log.exception(
                 "parallel corpus analysis failed; sequential fallback for "
                 "the %d incomplete contracts", len(payloads) - len(done))
@@ -428,6 +470,49 @@ class MythrilAnalyzer:
                 all_issues.extend(issues)
                 exceptions.extend(contract_exceptions)
         return all_issues, exceptions
+
+    @staticmethod
+    def _consume_pool(context, workers, payloads, done, stats, tracer):
+        """One pool generation: stream results off imap_unordered into
+        `done`, folding worker stats/trace snapshots into the parent.
+        Raises _PoolWorkerDied when the liveness watchdog sees a worker
+        process die (its in-flight task is lost — the pool respawns the
+        worker but never resubmits the work, so waiting would hang)."""
+        with context.Pool(processes=workers) as pool:
+            iterator = pool.imap_unordered(_corpus_worker, payloads)
+            watchdog = _PoolWatchdog(pool)
+            while True:
+                try:
+                    result = MythrilAnalyzer._next_result(
+                        iterator, watchdog)
+                except StopIteration:
+                    return
+                idx, issues, contract_exceptions, stats_snapshot, \
+                    trace_events = result
+                done[idx] = (issues, contract_exceptions)
+                stats.absorb(stats_snapshot)
+                # worker spans carry their own pid: each worker gets
+                # its own process lane in the merged timeline
+                tracer.absorb_events(trace_events)
+
+    _POOL_POLL_S = 0.25
+
+    @staticmethod
+    def _next_result(iterator, watchdog):
+        """Next streamed result, polling so the watchdog can observe
+        worker death between waits. Iterators without a timeout-taking
+        .next (plain generators — scripted pools in tests) are consumed
+        directly; multiprocessing's IMapUnorderedIterator exposes one."""
+        timed_next = getattr(iterator, "next", None)
+        if timed_next is None:
+            return next(iterator)
+        import multiprocessing as mp
+
+        while True:
+            try:
+                return timed_next(timeout=MythrilAnalyzer._POOL_POLL_S)
+            except mp.TimeoutError:
+                watchdog.check()
 
     @staticmethod
     def _phase_split(name, contract_start, solver_before, device_before,
@@ -491,6 +576,32 @@ class MythrilAnalyzer:
         return generate_graph(sym, physics=enable_physics)
 
 
+class _PoolWorkerDied(Exception):
+    """A --jobs worker process died with work in flight."""
+
+
+class _PoolWatchdog:
+    """Detects worker-process death in a multiprocessing.Pool. Two
+    observable signatures, either sufficient: a worker with an exitcode
+    (died, not yet reaped by the pool's maintenance thread), or the
+    worker pid set changing (the pool silently respawned a replacement —
+    which is exactly the case that loses the in-flight task)."""
+
+    def __init__(self, pool):
+        self._pool = pool
+        self._pids = self._snapshot()
+
+    def _snapshot(self):
+        return frozenset(
+            worker.pid for worker in getattr(self._pool, "_pool", ()))
+
+    def check(self) -> None:
+        workers = getattr(self._pool, "_pool", ())
+        if any(worker.exitcode is not None for worker in workers) \
+                or self._snapshot() != self._pids:
+            raise _PoolWorkerDied("a --jobs worker process died")
+
+
 def _corpus_worker(payload):
     """Spawn-process entry for one contract of a parallel corpus run.
 
@@ -507,6 +618,14 @@ def _corpus_worker(payload):
     idx, contract, address, strategy, modules, tx_count, args_state = payload
     args.__dict__.update(args_state)
     args.jobs = 1  # workers never re-fan-out
+    # each spawn worker re-arms the fault harness from the same spec the
+    # parent read (fresh interpreter, fresh crossing counters) and crosses
+    # the jobs.worker site once — `exit` plans kill the worker here, the
+    # shape a crashed/OOMed worker presents to the parent's watchdog
+    from mythril_tpu.resilience import faults, maybe_inject
+
+    faults.configure_from_env(getattr(args, "inject_fault", None))
+    maybe_inject("jobs.worker")
     from mythril_tpu.analysis.module import ModuleLoader
 
     for module in ModuleLoader().get_detection_modules():

@@ -221,6 +221,18 @@ def add_analysis_args(parser) -> None:
                              "router, device pack/ship/kernel, CDCL "
                              "settle, cache tiers, scheduler flushes) to "
                              "PATH; env equivalent: MYTHRIL_TPU_TRACE")
+    parser.add_argument("--inject-fault", metavar="SPEC", default=None,
+                        dest="inject_fault",
+                        help="arm the deterministic fault-injection "
+                             "harness (resilience/faults.py): a comma-"
+                             "separated list of site:kind:trigger plans, "
+                             "e.g. device.dispatch:raise:n1,"
+                             "disk.entry:corrupt:* — kinds raise|hang|"
+                             "delay|corrupt|exit, triggers n<k> (k-th "
+                             "crossing), r<p> (seeded rate) or * (every "
+                             "crossing); env equivalent: "
+                             "MYTHRIL_TPU_FAULTS (seed: "
+                             "MYTHRIL_TPU_FAULT_SEED)")
     parser.add_argument("--disable-mutation-pruner", action="store_true")
     parser.add_argument("--disable-coverage-strategy", action="store_true")
     parser.add_argument("--disable-dependency-pruning", action="store_true")

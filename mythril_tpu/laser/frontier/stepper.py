@@ -228,6 +228,12 @@ class FrontierStepper:
         per-state path must handle `lead`."""
         if not self._check_engine():
             return None
+        from mythril_tpu import resilience
+
+        if resilience.fuse_blown("frontier.step"):
+            # disable-for-session degradation: repeated batch-path faults
+            # blew the fuse; the per-state interpreter owns every state
+            return None
         pc = lead.mstate.pc
         if _span_skipped(lead, pc):
             return None
@@ -283,11 +289,28 @@ class FrontierStepper:
         if not survivors:
             return []
 
-        pad = (kernel.pad_slots(len(survivors))
-               if self.backend == "jax" else len(survivors))
-        frame = dense.encode_frontier(survivors, run, pad_to=pad)
-        stack_out, mem, written, msize, min_gas, max_gas, ok, mem_log = \
-            kernel.step_batch(run, frame, self.backend)
+        # registered disable-action fault site (frontier.step): a fault in
+        # encode/kernel sends every collected survivor down the existing
+        # bail path — untouched original states, flagged to replay the
+        # whole run per-state — so a batch-step fault can cost wall, never
+        # a state or a finding; repeated faults blow the session fuse
+        from mythril_tpu import resilience
+
+        try:
+            resilience.maybe_inject("frontier.step")
+            pad = (kernel.pad_slots(len(survivors))
+                   if self.backend == "jax" else len(survivors))
+            frame = dense.encode_frontier(survivors, run, pad_to=pad)
+            stack_out, mem, written, msize, min_gas, max_gas, ok, mem_log = \
+                kernel.step_batch(run, frame, self.backend)
+        except Exception:
+            log.warning("frontier batch step failed; per-state replay for "
+                        "%d state(s)", len(survivors), exc_info=True)
+            resilience.note_stage_failure("frontier.step")
+            for state in survivors:
+                state._frontier_skip_span = (run.start_pc, run.end_pc)
+                self._retract_loop_visit(state, run)
+            return survivors
 
         results = []
         completed = []

@@ -69,16 +69,24 @@ def get_code_summary(disassembly) -> Optional[CodeSummary]:
     if cached is not _MISS:
         return cached
     summary = None
+    from mythril_tpu import resilience
+
     try:
-        if isinstance(disassembly.bytecode, bytes) and disassembly.bytecode:
+        if (isinstance(disassembly.bytecode, bytes) and disassembly.bytecode
+                and not resilience.fuse_blown("preanalysis.summary")):
             from mythril_tpu.observe.tracer import span as trace_span
 
+            resilience.maybe_inject("preanalysis.summary")
             with trace_span("preanalysis.summary", cat="analyze",
                             code_bytes=len(disassembly.bytecode)):
                 summary = CodeSummary(disassembly)
     except Exception:
         # pre-analysis must never break an analysis: degrade to "no info"
+        # (nothing gated, every module attaches — the registered
+        # disable-action site preanalysis.summary; repeated faults blow
+        # the session fuse so a deterministic fault stops re-firing)
         log.exception("preanalysis failed; continuing without summaries")
+        resilience.note_stage_failure("preanalysis.summary")
         summary = None
     try:
         disassembly._preanalysis_summary = summary

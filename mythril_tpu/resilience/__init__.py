@@ -1,0 +1,124 @@
+"""Pipeline-wide fault containment.
+
+Every acceleration layer in this repo is an optional fast path over a
+correct oracle (device kernel over the host CDCL, disk tier over a real
+solve, batched frontier over the per-state interpreter, incremental
+prepare over the full pipeline, --jobs workers over in-process
+execution). This package makes the failure handling of those layers a
+typed, tested property instead of an ad-hoc collection of excepts:
+
+  registry.py   the fault-site registry: each optional stage declares
+                ONE site and ONE sound-degradation action
+                (retry / breaker / quarantine / disable)
+  breaker.py    per-stage circuit breaker with half-open re-probe
+                (generalizes the router's zero-hit waste breaker)
+  deadline.py   hard deadline wrapper for the device ship/kernel seam
+                (a wedged backend trips the breaker instead of hanging
+                the query)
+  faults.py     deterministic injection harness
+                (MYTHRIL_TPU_FAULTS=<site>:<kind>:<trigger>,... /
+                --inject-fault), driving the chaos suite
+                (tests/test_chaos.py) whose invariant is: under every
+                injected fault class, analysis completes with findings
+                byte-identical to the no-fault run
+
+plus, here: session FUSES for the disable-for-session action, and the
+jittered-retry helper for the retry action. Every event (retry, trip,
+probe, quarantine, degradation, deadline, requeue, stale lock break,
+injection) flows into SolverStatistics, the stats JSON `resilience`
+section, and the span tracer as tagged zero-width spans.
+"""
+
+import logging
+import random
+import time
+import zlib
+from typing import Callable, Dict
+
+from mythril_tpu.resilience import registry  # noqa: F401 (public API)
+from mythril_tpu.resilience.breaker import StageBreaker  # noqa: F401
+from mythril_tpu.resilience.deadline import (  # noqa: F401
+    StageDeadlineExceeded,
+    run_with_deadline,
+)
+from mythril_tpu.resilience.faults import (  # noqa: F401
+    InjectedFault,
+    corrupt_text,
+    maybe_inject,
+)
+
+log = logging.getLogger(__name__)
+
+# failures of a disable-action stage before its session fuse blows: a
+# transient hiccup costs one degraded event; a DETERMINISTIC fault (same
+# exception every query) reaches the threshold within a few queries and
+# the stage stays off for the session instead of failing-and-degrading
+# thousands of times
+FUSE_THRESHOLD = 3
+
+_fuse_failures: Dict[str, int] = {}
+_fuses_blown: Dict[str, bool] = {}
+
+
+def record_event(site: str, event: str, count: int = 1) -> None:
+    """Count one resilience event (SolverStatistics + stats JSON
+    `resilience` section) and mark it on the span timeline as a
+    zero-width tagged event."""
+    from mythril_tpu.observe.tracer import span as trace_span
+    from mythril_tpu.smt.solver.statistics import SolverStatistics
+
+    SolverStatistics().add_resilience_event(site, event, count)
+    with trace_span("resilience." + event, cat="resilience", site=site):
+        pass
+
+
+def note_stage_failure(site: str, hard: bool = False) -> bool:
+    """One failure of a disable-action stage: counts a `degraded` event
+    and charges the session fuse (hard=True blows it immediately).
+    Returns True when the fuse just blew."""
+    record_event(site, "degraded")
+    if _fuses_blown.get(site):
+        return False
+    failures = _fuse_failures.get(site, 0) + 1
+    _fuse_failures[site] = failures
+    if hard or failures >= FUSE_THRESHOLD:
+        _fuses_blown[site] = True
+        log.warning(
+            "%s disabled for the rest of the session after %d failure(s): "
+            "%s", site, failures,
+            registry.FAULT_SITES[site].degrades_to
+            if site in registry.FAULT_SITES else "sound path takes over")
+        return True
+    return False
+
+
+def fuse_blown(site: str) -> bool:
+    """Is this disable-action stage off for the session?"""
+    return _fuses_blown.get(site, False)
+
+
+def with_retries(site: str, fn: Callable, attempts: int = 2,
+                 base_delay_s: float = 0.002):
+    """Run `fn`, retrying transient failures with seeded jittered
+    backoff (deterministic under the fault harness — the jitter RNG
+    seeds on the site name + pid, so two contending workers draw
+    DIFFERENT jitter and desynchronize instead of retrying in
+    lockstep). Each retry counts a `retry` event; the final failure
+    propagates for the caller to degrade."""
+    import os
+
+    rng = random.Random(zlib.crc32(site.encode()) ^ os.getpid())
+    for attempt in range(attempts):
+        try:
+            return fn()
+        except Exception:
+            if attempt + 1 >= attempts:
+                raise
+            record_event(site, "retry")
+            time.sleep(base_delay_s * (2 ** attempt) * (1 + rng.random()))
+
+
+def reset_session() -> None:
+    """Drop session fuses and failure counts (clear_caches/tests)."""
+    _fuse_failures.clear()
+    _fuses_blown.clear()

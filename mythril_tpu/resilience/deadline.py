@@ -1,0 +1,108 @@
+"""Hard deadline wrapper for the device ship/kernel seam.
+
+The router's dispatch budget bounds how long the kernel LOOP runs — but
+only if control ever comes back from the backend. A wedged transport
+(the axon tunnel that has hung bench rounds for 120s at a time, per
+ROADMAP) blocks INSIDE a jax call with no Python-level preemption point,
+and the whole analysis hangs with it. The only sound rescue without
+killing the process is to run the device call on a separate thread and
+abandon it at the deadline: the query proceeds on the host CDCL, the
+stage breaker opens, and the wedged call either finishes late into a
+discarded result or stays stuck in its daemon thread until exit.
+
+One PERSISTENT runner thread (not thread-per-call): device work keeps a
+stable thread identity across dispatches (jit caches, XLA client state),
+and the steady-state cost per call is one queue round-trip. When a call
+times out the runner is marked wedged and abandoned — the next admitted
+call (the breaker's half-open probe, typically) gets a fresh runner with
+fresh queues, so a late result from the wedged thread can never be
+mistaken for the new call's.
+"""
+
+import logging
+import queue
+import threading
+from typing import Callable, Optional
+
+log = logging.getLogger(__name__)
+
+
+class StageDeadlineExceeded(RuntimeError):
+    """The wrapped call did not return within its hard deadline."""
+
+
+class _Runner:
+    def __init__(self):
+        self._inbox: "queue.Queue" = queue.Queue()
+        self._outbox: "queue.Queue" = queue.Queue()
+        self.wedged = False
+        self._thread = threading.Thread(
+            target=self._loop, name="mythril-tpu-stage-runner", daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            fn = self._inbox.get()
+            if fn is None:
+                return
+            try:
+                self._outbox.put((True, fn()))
+            except BaseException as error:  # delivered to the caller
+                self._outbox.put((False, error))
+
+    def call(self, fn: Callable, deadline_s: float):
+        self._inbox.put(fn)
+        try:
+            ok, payload = self._outbox.get(timeout=deadline_s)
+        except queue.Empty:
+            self.wedged = True
+            raise StageDeadlineExceeded(
+                f"stage call exceeded its {deadline_s:.1f}s hard deadline")
+        if ok:
+            return payload
+        raise payload
+
+
+_runner: Optional[_Runner] = None
+_runner_lock = threading.Lock()
+
+
+def _get_runner() -> _Runner:
+    global _runner
+    with _runner_lock:
+        if _runner is None or _runner.wedged:
+            _runner = _Runner()
+        return _runner
+
+
+def run_with_deadline(site: str, fn: Callable, deadline_s: float):
+    """Run `fn` under a hard deadline. On timeout: counts a `deadline`
+    resilience event for `site` and raises StageDeadlineExceeded — the
+    caller degrades to its sound path and feeds its breaker a hard
+    failure. Exceptions from `fn` propagate unchanged. A non-positive
+    deadline means no bound (inline call)."""
+    if deadline_s is None or deadline_s <= 0:
+        return fn()
+    try:
+        runner = _get_runner()
+    except Exception:  # cannot thread: run inline, unguarded
+        return fn()
+    try:
+        return runner.call(fn, deadline_s)
+    except StageDeadlineExceeded:
+        from mythril_tpu.resilience import record_event
+
+        record_event(site, "deadline")
+        log.warning("%s exceeded its %.1fs hard deadline: abandoning the "
+                    "call (wedged backend?); the sound path takes over",
+                    site, deadline_s)
+        raise
+
+
+def reset() -> None:
+    """Testing hook: drop the runner (a wedged one is abandoned)."""
+    global _runner
+    with _runner_lock:
+        if _runner is not None and not _runner.wedged:
+            _runner._inbox.put(None)
+        _runner = None

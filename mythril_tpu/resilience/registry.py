@@ -1,0 +1,129 @@
+"""Typed fault-domain registry.
+
+Every OPTIONAL stage of the pipeline — each acceleration layer built in
+front of a correct-but-slower oracle — registers exactly one fault site
+here, with a DECLARED sound-degradation action. The registry is static
+data on purpose: the lint (tools/check_fault_sites.py) walks it and
+fails tier-1 when a site lacks a degradation action, lacks a chaos test,
+or is registered but never wired into the code, so "we handle failures
+there" can never again be an undocumented claim.
+
+Degradation actions (the vocabulary of the tentpole):
+
+  retry       transient device/IO faults: retry with jittered backoff
+              (seeded — reproducible under the fault harness), then
+              degrade. Used for disk writes, lock acquisition, coalesced
+              flushes (per-query isolation retry), and --jobs worker
+              death (requeue the dead worker's pending inputs once).
+  breaker     per-stage circuit breaker (breaker.py): repeated or hard
+              failures open the stage; after a cooldown a single
+              half-open probe may re-close it. Generalizes the router's
+              zero-hit waste breaker.
+  quarantine  corrupt/unverifiable cache entries: the entry file is
+              moved aside (never re-read, preserved for forensics) and
+              the lookup proceeds as a safe miss — the oracle recomputes.
+  disable     deterministic faults in a pure-optimization layer: the
+              layer is disabled for the rest of the session (fuse in
+              __init__.py) and the sound full pipeline runs instead.
+
+Every degradation lands on the sound path: the host CDCL, the full
+prepare pipeline, the per-state interpreter, in-process execution, or a
+cache miss. None of them can change findings — that is the chaos-suite
+invariant (tests/test_chaos.py).
+"""
+
+from typing import Dict, NamedTuple, Tuple
+
+ACTIONS = ("retry", "breaker", "quarantine", "disable")
+
+# injection kinds the harness understands (faults.py):
+#   raise    raise InjectedFault at the site
+#   hang     block at the site (the deadline wrapper must rescue)
+#   delay    short sleep (transient-fault shape for retry sites)
+#   corrupt  mangle bytes flowing through the site (cache entries)
+#   exit     kill the process (worker-death shape; --jobs workers only)
+KINDS = ("raise", "hang", "delay", "corrupt", "exit")
+
+
+class FaultSite(NamedTuple):
+    name: str
+    layer: str            # subsystem the site lives in
+    action: str           # declared degradation action (ACTIONS)
+    kinds: Tuple[str, ...]  # injection kinds meaningful at this site
+    degrades_to: str      # the sound path a failure lands on
+
+
+FAULT_SITES: Dict[str, FaultSite] = {
+    site.name: site
+    for site in (
+        FaultSite(
+            "device.dispatch", "tpu/router", "breaker",
+            ("raise", "hang"),
+            "host CDCL settles the batch; breaker opens on waste/"
+            "deadline, half-open re-probe after cooldown"),
+        FaultSite(
+            "device.calibrate", "tpu/router", "disable",
+            ("raise",),
+            "uncalibrated defaults for the session (raised static caps)"),
+        FaultSite(
+            "disk.entry", "service/store", "quarantine",
+            ("corrupt", "raise"),
+            "entry quarantined, lookup degrades to a safe miss "
+            "(counted persistent_verify_rejects)"),
+        FaultSite(
+            "disk.write", "service/store", "retry",
+            ("raise", "delay"),
+            "one jittered-backoff retry, then the verdict simply is not "
+            "persisted (reads re-solve)"),
+        FaultSite(
+            "store.lock", "support/lock", "retry",
+            ("raise",),
+            "stale locks broken (owner-pid liveness + max-age); a broken "
+            "lock layer degrades to unlocked atomic-rename writes"),
+        FaultSite(
+            "scheduler.flush", "service/scheduler", "retry",
+            ("raise",),
+            "failed window flush retries each buffered query "
+            "individually; only a query that fails alone degrades to "
+            "unknown (possibly-feasible)"),
+        FaultSite(
+            "prepare.incremental", "smt/solver/incremental", "disable",
+            ("raise",),
+            "full (non-resumed) prepare pipeline; repeated faults blow "
+            "the session fuse"),
+        FaultSite(
+            "aig.session", "preanalysis/aig_opt", "disable",
+            ("raise",),
+            "identity rewrite (un-optimized cone); repeated faults blow "
+            "the session fuse"),
+        FaultSite(
+            "frontier.step", "laser/frontier", "disable",
+            ("raise",),
+            "per-state interpreter steps the states; repeated faults "
+            "blow the session fuse"),
+        FaultSite(
+            "preanalysis.summary", "preanalysis", "disable",
+            ("raise",),
+            "no static summary: nothing is gated, every module attaches "
+            "(the pre-PR-3 behavior, always findings-sound)"),
+        FaultSite(
+            "jobs.worker", "core", "retry",
+            ("raise", "exit"),
+            "dead worker's pending contracts requeued into a fresh pool "
+            "once, then analyzed in-process"),
+    )
+}
+
+
+def validate() -> None:
+    """Structural sanity of the registry itself (called by the lint)."""
+    for name, site in FAULT_SITES.items():
+        assert name == site.name, f"registry key {name!r} != {site.name!r}"
+        assert site.action in ACTIONS, \
+            f"fault site {name}: unknown action {site.action!r}"
+        assert site.kinds, f"fault site {name}: no injection kinds"
+        for kind in site.kinds:
+            assert kind in KINDS, \
+                f"fault site {name}: unknown injection kind {kind!r}"
+        assert site.degrades_to, \
+            f"fault site {name}: no degradation description"

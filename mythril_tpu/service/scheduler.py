@@ -43,11 +43,7 @@ DEFAULT_COALESCE_MS = 6.0
 DEFAULT_COALESCE_MAX = 16
 
 
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ[name])
-    except (KeyError, ValueError):
-        return default
+from mythril_tpu.support.env import env_float as _env_float
 
 
 class SolveHandle:
@@ -142,13 +138,13 @@ class CoalescingScheduler:
         return [handle.result() for handle in handles]
 
     def flush(self) -> None:
-        """Solve everything buffered: one get_models_batch call per
-        distinct crosscheck flag (submission order preserved per group)."""
+        """Solve everything buffered: one _solve_group per distinct
+        crosscheck flag (submission order preserved per group; the group
+        solve and its per-query failure isolation live in _solve_group)."""
         if not self._buffer:
             return
         from mythril_tpu.observe.tracer import span as trace_span
         from mythril_tpu.smt.solver.statistics import SolverStatistics
-        from mythril_tpu.support.model import get_models_batch
 
         buffered, self._buffer = self._buffer, []
         self._oldest = None
@@ -159,19 +155,44 @@ class CoalescingScheduler:
         with trace_span("scheduler.flush", cat="service",
                         queries=len(buffered), groups=len(groups)):
             for flag, entries in groups.items():
-                try:
-                    outcomes = get_models_batch(
-                        [constraints for _h, constraints, _f in entries],
-                        crosscheck=flag,
-                    )
-                except Exception:
-                    # a handle must never dangle: degrade the cohort to
-                    # unknown (callers treat unknown as possibly-feasible)
-                    log.exception("coalesced solve flush failed; cohort of "
-                                  "%d degraded to unknown", len(entries))
-                    outcomes = [("unknown", None)] * len(entries)
+                outcomes = self._solve_group(flag, entries)
                 for (handle, _c, _f), outcome in zip(entries, outcomes):
                     handle._resolve(outcome)
+
+    def _solve_group(self, flag, entries) -> List:
+        """Solve one crosscheck-group of a window flush. Registered fault
+        site scheduler.flush (retry action): a query raising inside the
+        coalesced batch must fail ONLY its own handle — the batched call
+        is retried query-by-query so the buffered siblings that happened
+        to share the window still get their real verdicts, and only a
+        query that fails ALONE degrades to unknown (possibly-feasible —
+        a handle must never dangle, and unknown can cost precision on
+        that one query, never a missed finding on its siblings)."""
+        from mythril_tpu.resilience import maybe_inject, record_event
+        from mythril_tpu.support.model import get_models_batch
+
+        try:
+            maybe_inject("scheduler.flush")
+            return get_models_batch(
+                [constraints for _h, constraints, _f in entries],
+                crosscheck=flag,
+            )
+        except Exception:
+            log.warning("coalesced solve flush failed; retrying the %d "
+                        "buffered quer(ies) individually",
+                        len(entries), exc_info=True)
+            record_event("scheduler.flush", "retry")
+        outcomes = []
+        for _handle, constraints, _f in entries:
+            try:
+                outcomes.append(
+                    get_models_batch([constraints], crosscheck=flag)[0])
+            except Exception:
+                log.exception("query failed alone after a flush failure; "
+                              "degrading it (only) to unknown")
+                record_event("scheduler.flush", "degraded")
+                outcomes.append(("unknown", None))
+        return outcomes
 
     def clear(self) -> None:
         """Discard buffered state WITHOUT solving (clear_caches/test

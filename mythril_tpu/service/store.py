@@ -19,6 +19,11 @@ by the instance fingerprint (fingerprint.py). The store is shared across
             every entry instead of trusting stale formats
 
 Entry trust model:
+  corrupt  any malformed entry (truncated write, garbage bytes, wrong
+         schema stamp, undecodable blob) is QUARANTINED on lookup —
+         moved to a `.quarantined` sibling, counted as a
+         persistent_verify_reject + a resilience quarantine event — and
+         the lookup degrades to a safe miss (the oracle recomputes)
   SAT    stores the satisfying assignment bits (packed, base64). A hit is
          NEVER trusted as-is — the caller replays the bits through
          Solver._reconstruct, which validates the rebuilt model against
@@ -165,7 +170,8 @@ class PersistentResultStore:
                 except OSError:
                     pass
                 for name in os.listdir(self.root):
-                    if name.endswith(".json"):
+                    if name.endswith(".json") \
+                            or name.endswith(".quarantined"):
                         try:
                             os.unlink(os.path.join(self.root, name))
                         except OSError:
@@ -188,36 +194,105 @@ class PersistentResultStore:
     # -- reads --------------------------------------------------------------
 
     def lookup(self, fingerprint: str) -> Optional[StoreEntry]:
+        """Read one entry; every malformed entry (truncated write,
+        garbage bytes, wrong schema stamp, undecodable assignment blob)
+        is QUARANTINED — moved aside so it is never re-read — counted as
+        a persistent_verify_reject, and the lookup proceeds as a safe
+        miss. A missing file is a plain miss (nothing to quarantine)."""
         if not self._ok or not fingerprint:
             return None
         path = self._path(fingerprint)
         try:
             with open(path) as fd:
-                payload = json.load(fd)
-        except (OSError, ValueError):
-            return None
-        if payload.get("schema") != STORE_SCHEMA_VERSION:
-            return None
+                text = fd.read()
+        except OSError:
+            return None  # no entry: plain miss
+        from mythril_tpu.resilience import (
+            InjectedFault,
+            corrupt_text,
+            maybe_inject,
+        )
+
+        try:
+            maybe_inject("disk.entry")
+            payload = json.loads(corrupt_text("disk.entry", text))
+        except (InjectedFault, ValueError):
+            return self._quarantine(path, "unparseable entry")
+        if not isinstance(payload, dict) \
+                or payload.get("schema") != STORE_SCHEMA_VERSION:
+            return self._quarantine(path, "wrong schema stamp")
         verdict = payload.get("verdict")
         if verdict == "sat":
             num_vars = payload.get("num_vars")
             blob = payload.get("bits")
             if not isinstance(num_vars, int) or not isinstance(blob, str):
-                return None
+                return self._quarantine(path, "malformed sat payload")
             bits = _unpack_bits(blob, num_vars)
             if bits is None:
-                return None
+                return self._quarantine(path, "undecodable assignment")
             entry = StoreEntry("sat", bits=bits, num_vars=num_vars)
         elif verdict == "unsat":
             entry = StoreEntry(
                 "unsat", crosschecked=bool(payload.get("crosschecked")))
         else:
-            return None
+            return self._quarantine(path, "unknown verdict")
         try:
             os.utime(path, None)  # LRU recency
         except OSError:
             pass
         return entry
+
+    # quarantined corpses kept for forensics; beyond this the oldest are
+    # dropped — a recurring corruption source (flaky disk, mixed-version
+    # writers) must not grow the cache dir past its caps through files
+    # the eviction sweep does not see
+    _QUARANTINE_KEEP = 32
+
+    def _quarantine(self, path: str, reason: str) -> None:
+        """Move a corrupt/unverifiable entry aside (never re-read; the
+        newest _QUARANTINE_KEEP are kept for forensics — the
+        `.quarantined` suffix excludes them from lookups, counts and
+        eviction) and degrade to a safe miss. The oracle recomputes the
+        verdict; a corrupt entry can cost a solve, never a finding."""
+        from mythril_tpu.resilience import record_event
+        from mythril_tpu.smt.solver.statistics import SolverStatistics
+
+        log.warning("quarantining corrupt solve-cache entry %s (%s)",
+                    os.path.basename(path), reason)
+        SolverStatistics().add_persistent_verify_reject()
+        record_event("disk.entry", "quarantine")
+        try:
+            os.replace(path, path + ".quarantined")
+        except OSError:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            return None
+        self._prune_quarantined()
+        return None
+
+    def _prune_quarantined(self) -> None:
+        """Drop the oldest quarantined corpses beyond the forensics cap
+        (unlink races with sibling processes are benign: someone pruned)."""
+        try:
+            corpses = []
+            for name in os.listdir(self.root):
+                if not name.endswith(".quarantined"):
+                    continue
+                corpse = os.path.join(self.root, name)
+                try:
+                    corpses.append((os.path.getmtime(corpse), corpse))
+                except OSError:
+                    continue
+            corpses.sort()
+            for _mtime, corpse in corpses[:-self._QUARANTINE_KEEP]:
+                try:
+                    os.unlink(corpse)
+                except OSError:
+                    pass
+        except OSError:
+            pass
 
     # -- writes -------------------------------------------------------------
 
@@ -242,53 +317,71 @@ class PersistentResultStore:
     _COUNT_SYNC_INTERVAL = 256
 
     def _write(self, fingerprint: str, payload: dict) -> bool:
+        """Write one entry, retrying a transient IO failure once with
+        jittered backoff (resilience registry: the disk.write fault
+        site); a persistent failure degrades to not-persisted — reads
+        simply re-solve, never a wrong verdict."""
         if not self._ok or not fingerprint:
             return False
+        from mythril_tpu.resilience import record_event, with_retries
+
         try:
-            with self._lock():
-                path = self._path(fingerprint)
-                # overwrite of an existing fingerprint (e.g. a provenance
-                # upgrade of an UNSAT entry) replaces, not adds: count the
-                # old file out first or the approximations inflate and
-                # trigger spurious O(entries) eviction scans under the lock
-                old_size = None
-                try:
-                    old_size = os.path.getsize(path)
-                except OSError:
-                    pass
-                if not atomic_write_json(path, payload):
-                    return False
-                if self._approx_count is None:
-                    self._approx_count = self.entry_count()
-                elif old_size is None:
-                    self._approx_count += 1
-                if self.max_bytes:
-                    if self._approx_bytes is None:
-                        self._approx_bytes = self.total_bytes()
-                    else:
-                        try:
-                            self._approx_bytes += (
-                                os.path.getsize(path) - (old_size or 0))
-                        except OSError:
-                            pass
-                self._writes_since_sync += 1
-                if self._writes_since_sync >= self._COUNT_SYNC_INTERVAL:
-                    # re-sync against sibling workers' writes
-                    self._approx_count = self.entry_count()
-                    if self.max_bytes:
-                        self._approx_bytes = self.total_bytes()
-                    self._writes_since_sync = 0
-                if self._approx_count > self.max_entries or (
-                        self.max_bytes
-                        and (self._approx_bytes or 0) > self.max_bytes):
-                    # eviction walks the directory once and returns the
-                    # exact post-eviction figures — re-scanning here would
-                    # triple the O(entries) stat sweeps under the lock
-                    self._approx_count, self._approx_bytes = \
-                        self._evict_locked()
-            return True
-        except OSError:
+            return with_retries(
+                "disk.write",
+                lambda: self._write_locked(fingerprint, payload))
+        except Exception:
+            record_event("disk.write", "degraded")
             return False
+
+    def _write_locked(self, fingerprint: str, payload: dict) -> bool:
+        """One locked write attempt; RAISES on IO failure so the retry
+        wrapper in _write sees it (the pre-resilience silent False made
+        every transient failure permanent)."""
+        from mythril_tpu.resilience import maybe_inject
+
+        with self._lock():
+            maybe_inject("disk.write")
+            path = self._path(fingerprint)
+            # overwrite of an existing fingerprint (e.g. a provenance
+            # upgrade of an UNSAT entry) replaces, not adds: count the
+            # old file out first or the approximations inflate and
+            # trigger spurious O(entries) eviction scans under the lock
+            old_size = None
+            try:
+                old_size = os.path.getsize(path)
+            except OSError:
+                pass
+            if not atomic_write_json(path, payload):
+                raise OSError("atomic entry write failed")
+            if self._approx_count is None:
+                self._approx_count = self.entry_count()
+            elif old_size is None:
+                self._approx_count += 1
+            if self.max_bytes:
+                if self._approx_bytes is None:
+                    self._approx_bytes = self.total_bytes()
+                else:
+                    try:
+                        self._approx_bytes += (
+                            os.path.getsize(path) - (old_size or 0))
+                    except OSError:
+                        pass
+            self._writes_since_sync += 1
+            if self._writes_since_sync >= self._COUNT_SYNC_INTERVAL:
+                # re-sync against sibling workers' writes
+                self._approx_count = self.entry_count()
+                if self.max_bytes:
+                    self._approx_bytes = self.total_bytes()
+                self._writes_since_sync = 0
+            if self._approx_count > self.max_entries or (
+                    self.max_bytes
+                    and (self._approx_bytes or 0) > self.max_bytes):
+                # eviction walks the directory once and returns the
+                # exact post-eviction figures — re-scanning here would
+                # triple the O(entries) stat sweeps under the lock
+                self._approx_count, self._approx_bytes = \
+                    self._evict_locked()
+        return True
 
     def _evict_locked(self):
         """LRU eviction by mtime until BOTH caps hold (entry count, and —

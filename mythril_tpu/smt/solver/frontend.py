@@ -18,9 +18,11 @@ assumptions over the objective's CNF bits (role of z3.Optimize in
 reference analysis/solver.py:217-257 exploit minimization).
 """
 
+import logging
 import time
 from typing import Dict, List, Optional, Tuple
 
+from mythril_tpu import resilience
 from mythril_tpu.observe.tracer import NULL_SPAN, span as trace_span
 from mythril_tpu.smt import terms
 from mythril_tpu.smt.bitblast import Blaster
@@ -30,6 +32,8 @@ from mythril_tpu.smt.model import Model
 from mythril_tpu.smt.solver import sat_backend
 from mythril_tpu.smt.solver.statistics import SolverStatistics
 from mythril_tpu.smt.terms import BOOL, Term
+
+log = logging.getLogger(__name__)
 
 
 class UnsatError(Exception):
@@ -472,7 +476,12 @@ class Solver:
         # Optimize objectives — objectives interleave with the lowering
         # state and the memo would have to snapshot them too for no
         # production traffic (the engine's sibling fan-out never minimizes).
-        use_incr = not objectives and incremental.enabled()
+        # the incremental layer is a registered disable-action fault site
+        # (resilience/registry.py prepare.incremental): a fault inside it
+        # degrades THIS query to the full pipeline, and repeated faults
+        # blow the session fuse so the layer stays off
+        use_incr = (not objectives and incremental.enabled()
+                    and not resilience.fuse_blown("prepare.incremental"))
         simplify = (incremental.simplify_cached if use_incr
                     else terms.simplify_expr)
         asserted: List[Term] = []
@@ -486,7 +495,18 @@ class Solver:
             asserted.append(term)
         prep.original = asserted
 
-        resume = incremental.try_resume(asserted) if use_incr else None
+        resume = None
+        if use_incr:
+            try:
+                resilience.maybe_inject("prepare.incremental")
+                resume = incremental.try_resume(asserted)
+            except Exception:
+                log.warning("incremental prefix resume failed; full "
+                            "prepare pipeline for this query",
+                            exc_info=True)
+                resilience.note_stage_failure("prepare.incremental")
+                use_incr = False
+                resume = None
         if resume is not None and resume.unsat:
             prep.trivial = UNSAT
             return prep
@@ -587,37 +607,53 @@ class Solver:
         # prep.aig_roots carries the rewritten (aig, roots, dense) the
         # device path and fingerprint consume.
         aig_opted = False
-        if not objectives:
+        if not objectives and not resilience.fuse_blown("aig.session"):
             from mythril_tpu.preanalysis import aig_opt
 
             if aig_opt.enabled():
-                roots = [prep.blaster.assert_bool(t) for t in lowered]
-                prep.blaster.last_roots = roots
-                with trace_span("solver.aig_opt", cat="solver",
-                                roots=len(roots)):
-                    opt = aig_opt.optimize_roots_cached(
-                        prep.blaster.aig, roots)
-                if opt is not None:
-                    prep.num_vars, prep.clauses, opt_dense = opt.aig.to_cnf(
-                        list(opt.roots))
-                    prep.aig_roots = (opt.aig, list(opt.roots), opt_dense)
-                    prep.var_dense = aig_opt.ComposedDense(
-                        opt.input_map, opt_dense)
-                    stats = SolverStatistics()
-                    stats.add_aig_opt(
-                        opt.nodes_before, opt.nodes_after,
-                        opt.strash_merges, opt.const_folds,
-                        trivial_unsat=opt.trivially_unsat)
-                    # gates reused from SIBLING queries via the session
-                    # strash table (cross-query structural sharing)
-                    stats.add_strash_xquery(opt.xquery_merges)
-                    from mythril_tpu.preanalysis import aig_partition
+                # registered disable-action fault site (aig.session): a
+                # fault anywhere in the rewrite degrades THIS query to the
+                # un-rewritten blaster CNF below — assert_bool/cnf are
+                # memoized, so the fallback re-lowering is free and lands
+                # on identical roots — and repeated faults blow the
+                # session fuse
+                try:
+                    resilience.maybe_inject("aig.session")
+                    roots = [prep.blaster.assert_bool(t) for t in lowered]
+                    prep.blaster.last_roots = roots
+                    with trace_span("solver.aig_opt", cat="solver",
+                                    roots=len(roots)):
+                        opt = aig_opt.optimize_roots_cached(
+                            prep.blaster.aig, roots)
+                    if opt is not None:
+                        prep.num_vars, prep.clauses, opt_dense = \
+                            opt.aig.to_cnf(list(opt.roots))
+                        prep.aig_roots = (opt.aig, list(opt.roots),
+                                          opt_dense)
+                        prep.var_dense = aig_opt.ComposedDense(
+                            opt.input_map, opt_dense)
+                        stats = SolverStatistics()
+                        stats.add_aig_opt(
+                            opt.nodes_before, opt.nodes_after,
+                            opt.strash_merges, opt.const_folds,
+                            trivial_unsat=opt.trivially_unsat)
+                        # gates reused from SIBLING queries via the
+                        # session strash table (cross-query sharing)
+                        stats.add_strash_xquery(opt.xquery_merges)
+                        from mythril_tpu.preanalysis import aig_partition
 
-                    partition = aig_partition.partition_cached(
-                        opt.aig, opt.roots)
-                    if partition is not None:
-                        stats.add_aig_components(len(partition.components))
-                    aig_opted = True
+                        partition = aig_partition.partition_cached(
+                            opt.aig, opt.roots)
+                        if partition is not None:
+                            stats.add_aig_components(
+                                len(partition.components))
+                        aig_opted = True
+                except Exception:
+                    log.warning("AIG session optimization failed; "
+                                "un-rewritten CNF for this query",
+                                exc_info=True)
+                    resilience.note_stage_failure("aig.session")
+                    aig_opted = False
         if not aig_opted:
             prep.num_vars, prep.clauses, prep.var_dense = prep.blaster.cnf(
                 lowered, objective_lits)

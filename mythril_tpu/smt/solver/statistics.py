@@ -83,7 +83,37 @@ class SolverStatistics:
         "frontier_states_stepped",
         "frontier_fallback_exits",
         "frontier_batch_slots",
+        # fault containment (mythril_tpu/resilience/): every degradation
+        # a registered fault site took — retries with jittered backoff,
+        # per-stage breaker trips and half-open re-probes, quarantined
+        # cache entries, degraded-to-oracle events, hard-deadline trips
+        # at the device seam, --jobs worker requeues, stale lock breaks,
+        # and deterministically injected faults (the chaos harness).
+        # The per-site breakdown lives in resilience_events (emitted as
+        # the stats JSON "resilience" section).
+        "resilience_retries",
+        "resilience_breaker_trips",
+        "resilience_breaker_probes",
+        "resilience_quarantines",
+        "resilience_degraded",
+        "resilience_deadline_trips",
+        "resilience_worker_requeues",
+        "resilience_stale_lock_breaks",
+        "resilience_faults_injected",
     )
+
+    # resilience event name -> the scalar counter it rolls up into
+    _RESILIENCE_EVENT_COUNTERS = {
+        "retry": "resilience_retries",
+        "breaker_trip": "resilience_breaker_trips",
+        "breaker_probe": "resilience_breaker_probes",
+        "quarantine": "resilience_quarantines",
+        "degraded": "resilience_degraded",
+        "deadline": "resilience_deadline_trips",
+        "worker_requeue": "resilience_worker_requeues",
+        "stale_break": "resilience_stale_lock_breaks",
+        "injected": "resilience_faults_injected",
+    }
     _TIMERS = (
         "solver_time",
         "route_device_seconds",
@@ -129,6 +159,10 @@ class SolverStatistics:
             # top-10 by cumulative wall so each bench round names the
             # opcodes worth promoting into the frontier fast set next
             cls._instance.interp_opcode_wall = {}
+            # fault site -> {event name: count} (resilience/registry.py
+            # sites); the per-site view behind the scalar resilience_*
+            # counters, emitted as the stats JSON "resilience" section
+            cls._instance.resilience_events = {}
         return cls._instance
 
     def add_query(self, seconds: float) -> None:
@@ -395,6 +429,19 @@ class SolverStatistics:
             self.frontier_batch_slots += slots
             self.frontier_fallback_exits += fallback_exits
 
+    def add_resilience_event(self, site: str, event: str,
+                             count: int = 1) -> None:
+        """One fault-containment event at a registered fault site
+        (mythril_tpu/resilience/): bumps the matching resilience_*
+        scalar and the per-site breakdown behind the stats JSON
+        "resilience" section."""
+        if self.enabled:
+            counter = self._RESILIENCE_EVENT_COUNTERS.get(event)
+            if counter is not None:
+                setattr(self, counter, getattr(self, counter) + count)
+            per_site = self.resilience_events.setdefault(site, {})
+            per_site[event] = per_site.get(event, 0) + count
+
     def add_interp_seconds(self, seconds: float) -> None:
         """Wall spent stepping states in LaserEVM.exec (per-state +
         batched) — the interpreter component of the wall split."""
@@ -444,6 +491,7 @@ class SolverStatistics:
             setattr(self, name, 0.0)
         self.prepare_suffix_hist = {}
         self.interp_opcode_wall = {}
+        self.resilience_events = {}
 
     def interp_opcode_wall_top(self, n: int = 10) -> dict:
         """Top-`n` fallback-path opcodes by cumulative wall:
@@ -479,6 +527,20 @@ class SolverStatistics:
         from mythril_tpu.observe import roofline
 
         out["roofline"] = roofline.build(self)
+        # fault containment: per-site degradation events (every
+        # registered site appears, zero-filled, so the section's shape is
+        # stable for the check_fault_sites lint and post-hoc diffing) and
+        # the armed fault-injection spec, if any (chaos provenance)
+        from mythril_tpu.resilience import faults, registry
+
+        sites = {name: dict(self.resilience_events.get(name, {}))
+                 for name in registry.FAULT_SITES}
+        for site, events in self.resilience_events.items():
+            sites.setdefault(site, dict(events))
+        out["resilience"] = {
+            "sites": sites,
+            "faults_active": faults.active_spec(),
+        }
         # span-summary of the run's trace ({stage: [count, seconds]};
         # empty unless MYTHRIL_TPU_TRACE / --trace enabled the tracer)
         from mythril_tpu.observe.tracer import Tracer
@@ -515,6 +577,14 @@ class SolverStatistics:
             record = self.interp_opcode_wall.setdefault(op, [0, 0.0])
             record[0] += int(count)
             record[1] += float(seconds)
+        # per-site resilience events: a worker's breaker trips /
+        # quarantines / requeues must survive the --jobs merge like the
+        # scalar counters do (the scalars merged above via _COUNTERS)
+        worker_sites = (snapshot.get("resilience") or {}).get("sites") or {}
+        for site, events in worker_sites.items():
+            per_site = self.resilience_events.setdefault(site, {})
+            for event, count in events.items():
+                per_site[event] = per_site.get(event, 0) + int(count)
 
     def __repr__(self):
         out = (f"Solver statistics: query count: {self.query_count}, "
@@ -579,6 +649,13 @@ class SolverStatistics:
                     f" {self.aig_trivial_unsat} trivially unsat,"
                     f" {self.aig_components} components"
                     f"/{self.aig_device_components} on device)")
+        if self.resilience_events:
+            out += (f", resilience: {self.resilience_retries} retries"
+                    f"/{self.resilience_breaker_trips} breaker trips"
+                    f"/{self.resilience_quarantines} quarantines"
+                    f"/{self.resilience_degraded} degraded"
+                    f"/{self.resilience_deadline_trips} deadline trips"
+                    f" ({self.resilience_faults_injected} injected)")
         if self.crosscheck_runs or self.crosscheck_cap_skips:
             out += (f", unsat crosschecks: {self.crosscheck_runs}"
                     f" (+{self.crosscheck_cap_skips} cap-skipped)")

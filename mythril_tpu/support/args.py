@@ -43,6 +43,9 @@ class _Args:
         self.jobs = 1                          # corpus-parallel workers (-j)
         self.trace = None                      # --trace PATH (span tracer
         #   Perfetto export; MYTHRIL_TPU_TRACE is the env equivalent)
+        self.inject_fault = None               # --inject-fault SPEC (chaos
+        #   harness; MYTHRIL_TPU_FAULTS is the env equivalent —
+        #   resilience/faults.py grammar site:kind:trigger,...)
 
     def reset(self):
         self.__init__()

@@ -1,0 +1,13 @@
+"""Shared environment-variable parsing (one implementation instead of a
+try/except copy per module — the copies were already drifting)."""
+
+import os
+
+
+def env_float(name: str, default: float) -> float:
+    """`float(os.environ[name])`, or `default` when unset/malformed — a
+    mistyped knob must never crash a run at import time."""
+    try:
+        return float(os.environ[name])
+    except (KeyError, ValueError):
+        return default

@@ -669,3 +669,8 @@ def clear_caches() -> None:
 
     incremental.reset()
     aig_opt.reset_cache()
+    # fault containment: session fuses (disable-for-session degradations)
+    # are per-run state — a cleared process gets its optional stages back
+    from mythril_tpu import resilience
+
+    resilience.reset_session()

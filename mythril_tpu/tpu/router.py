@@ -33,10 +33,21 @@ This module replaces the hard-coded constants with a measured routing layer
                 pass always keeps a real window and a slow device can never
                 make analyze slower than host-only by more than the breaker
                 allows (below).
-  breaker     — a health breaker disables the device path for the rest of
-                the run once it has burned MYTHRIL_TPU_DEVICE_MAX_WASTE
-                seconds without producing a single model (wedged transport,
-                hopeless platform); any hit resets the waste meter.
+  breaker     — a per-stage circuit breaker (resilience/breaker.py, the
+                generalization of round-5's zero-hit health breaker)
+                opens the device path once it has burned
+                MYTHRIL_TPU_DEVICE_MAX_WASTE seconds without producing a
+                single model (wedged transport, hopeless platform), on
+                repeated dispatch exceptions, or IMMEDIATELY on a hard
+                deadline trip; any hit resets the meters, and after
+                MYTHRIL_TPU_BREAKER_COOLDOWN seconds one half-open
+                re-probe dispatch may close it again.
+  hard deadline — every dispatch runs under resilience.run_with_deadline:
+                a backend that wedges INSIDE a jax call (no Python
+                preemption point — the axon tunnel failure mode) is
+                abandoned on its runner thread at deadline + grace, the
+                breaker takes a hard failure, and the host CDCL settles
+                the batch instead of hanging the query.
   profiles    — on a real accelerator the device is cost-competitive and
                 dispatches run at full production settings (sharded dp x mp,
                 the configured restart batch). On the CPU platform the
@@ -81,6 +92,11 @@ import time
 from typing import List, Optional, Sequence, Tuple
 
 from mythril_tpu.observe.tracer import span as trace_span
+from mythril_tpu.resilience import (
+    StageDeadlineExceeded,
+    maybe_inject,
+    run_with_deadline,
+)
 from mythril_tpu.tpu.backend import shape_bucket
 
 log = logging.getLogger(__name__)
@@ -134,11 +150,7 @@ DEFAULT_VAR_CAP_DEVICE = 1 << 16
 CAL_STEPS = 8  # micro-calibration round length (tiny on purpose)
 
 
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ[name])
-    except (KeyError, ValueError):
-        return default
+from mythril_tpu.support.env import env_float as _env_float
 
 
 def _env_int(name: str) -> Optional[int]:
@@ -171,9 +183,14 @@ class QueryRouter:
         # roofline ceilings (observe/roofline.py)
         self._stage_rates = {}
         self._calibrated = False
-        self.disabled = False
-        self._waste_s = 0.0      # device seconds spent since the last hit
-        self._breaker_logged = False
+        from mythril_tpu.resilience import StageBreaker
+
+        # per-stage breaker (resilience/breaker.py): waste budget is
+        # resolved lazily (it needs the platform) via _waste_budget() on
+        # the first failure; a backend that is UNAVAILABLE (vs failing)
+        # force-opens it with an effectively-infinite cooldown
+        self._breaker = StageBreaker("device.dispatch")
+        self._unavailable = False
         self.dispatches = 0      # device dispatches this process
         self.round_budget_s = _env_float("MYTHRIL_TPU_ROUND_BUDGET", 4.0)
         self.max_waste_s = _env_float("MYTHRIL_TPU_DEVICE_MAX_WASTE", -1.0)
@@ -191,7 +208,11 @@ class QueryRouter:
 
     def _waste_budget(self) -> float:
         if self.max_waste_s >= 0:
-            return self.max_waste_s
+            # an EXPLICIT 0 means zero tolerance (trip on the first
+            # fruitless dispatch), not "no budget" — the breaker treats a
+            # 0.0 budget as unbudgeted, so map it to an epsilon any
+            # positive waste exceeds
+            return self.max_waste_s or 1e-9
         return 8.0 if self._platform() == "cpu" else 20.0
 
     # -- caps ---------------------------------------------------------------
@@ -298,6 +319,7 @@ class QueryRouter:
             return True
         try:
             start = time.monotonic()
+            maybe_inject("device.calibrate")
             self._per_cell_s = self._measure_round_latency()
             log.info("device micro-calibration: %.1fns/cell-ministep "
                      "(%.2fs total)", self._per_cell_s * 1e9,
@@ -307,6 +329,11 @@ class QueryRouter:
                           **self._stage_rates})
             return True
         except Exception as error:
+            # disable-for-session degradation: _calibrated stays True, so
+            # the raised static defaults apply for the rest of the run
+            from mythril_tpu import resilience
+
+            resilience.note_stage_failure("device.calibrate", hard=True)
             log.info("device micro-calibration failed (%s); "
                      "using default caps", error)
             self._per_cell_s = None
@@ -499,34 +526,59 @@ class QueryRouter:
         return (getattr(backend, "pack_seconds", 0.0)
                 + getattr(backend, "ship_seconds", 0.0)) / total
 
-    # -- health breaker -----------------------------------------------------
+    # -- health breaker (resilience/breaker.py) -----------------------------
+
+    @property
+    def disabled(self) -> bool:
+        """Device path off right now: backend unavailable, or the stage
+        breaker open (waste budget burned / repeated dispatch errors /
+        hard deadline trip). Unlike the pre-resilience breaker this is
+        no longer terminal: after the cooldown the breaker admits one
+        half-open re-probe dispatch, and a hit closes it again."""
+        return self._unavailable or self._breaker.tripped
+
+    @disabled.setter
+    def disabled(self, value: bool) -> None:
+        # compatibility/testing hook (the old breaker was a plain bool)
+        if value:
+            self._unavailable = True
+        else:
+            self._unavailable = False
+            self._breaker.reset()
 
     def device_usable(self) -> bool:
-        if self.disabled:
+        if self._unavailable:
             return False
         if not self.backend.available():
-            self.disabled = True
+            self._unavailable = True
             log.info("device backend unavailable: routing all queries to "
                      "the host CDCL for this run")
             return False
-        return True
+        if not self._breaker.waste_budget_s:
+            self._breaker.waste_budget_s = self._waste_budget()
+        return self._breaker.allow()
 
-    def record_dispatch(self, hits: int, seconds: float) -> None:
-        """Feed the breaker: device wall with zero models found is waste;
-        one hit forgives the meter."""
+    def record_dispatch(self, hits: int, seconds: float,
+                        errored: bool = False) -> None:
+        """Feed the breaker: device wall with zero models found charges
+        the waste budget (a legitimate miss, never the error count); a
+        dispatch EXCEPTION charges the error count; one hit forgives
+        everything."""
         self.dispatches += 1
+        if not self._breaker.waste_budget_s:
+            self._breaker.waste_budget_s = self._waste_budget()
         if hits > 0:
-            self._waste_s = 0.0
+            self._breaker.record_success()
             return
-        self._waste_s += seconds
-        if self._waste_s > self._waste_budget() and not self.disabled:
-            self.disabled = True
-            if not self._breaker_logged:
-                self._breaker_logged = True
-                log.warning(
-                    "device solver produced no models in %.1fs of device "
-                    "wall: disabling the device path for the rest of the "
-                    "run (host CDCL only)", self._waste_s)
+        self._breaker.record_failure(wasted_s=seconds, count=errored)
+
+    def record_deadline_trip(self) -> None:
+        """A dispatch blew its HARD deadline (wedged backend): the
+        breaker opens immediately — waiting out the waste budget on a
+        backend that no longer returns would hang every query for the
+        full deadline first."""
+        self.dispatches += 1
+        self._breaker.record_failure(hard=True)
 
     def _evidence_mode(self) -> bool:
         """True when the platform cannot beat the host CDCL on wall clock
@@ -545,6 +597,33 @@ class QueryRouter:
         deadline + one round (~the round budget) — still a constant."""
         default = 2.5 if self._platform() == "cpu" else 6.0
         return _env_float("MYTHRIL_TPU_DEVICE_DEADLINE", default)
+
+    def _deadline_grace(self) -> float:
+        """Slack past the dispatch budget before the HARD deadline fires:
+        the kernel loop honors the budget between rounds, so a healthy
+        backend returns within budget + one round. Only a backend that
+        stopped returning at all (wedged transport) reaches the hard
+        deadline — which is the point."""
+        return _env_float("MYTHRIL_TPU_STAGE_GRACE",
+                          max(self.round_budget_s, 2.0))
+
+    def _guarded_dispatch(self, group, remaining, caps, profile):
+        """One bucketed device dispatch under the fault-containment
+        seam: the registered injection site, then the backend call on
+        the deadline runner thread with a hard budget+grace bound."""
+
+        def _call():
+            maybe_inject("device.dispatch")
+            return self.backend.try_solve_batch_circuit(
+                [unit.problem for unit in group],
+                budget_seconds=remaining,
+                size_caps=caps,
+                packed_hint=[unit.pc for unit in group],
+                **profile,
+            )
+
+        return run_with_deadline(
+            "device.dispatch", _call, remaining + self._deadline_grace())
 
     # -- batched dispatch (support/model.get_models_batch) ------------------
 
@@ -667,9 +746,15 @@ class QueryRouter:
         # biggest group first: under the evidence-mode dispatch cap and the
         # shared deadline, the fullest bucket yields the most amortization
         # per dispatch (and the most device models per second spent)
+        from mythril_tpu.resilience import breaker as breaker_mod
+
         for bucket_level in sorted(
                 buckets, key=lambda b: -len(buckets[b])):
-            if self._dispatches_remaining() <= 0 or self.disabled:
+            # break once the breaker is OPEN (tripped mid-loop) — but a
+            # HALF_OPEN probe admitted at device_usable() must reach its
+            # one dispatch (a miss re-opens and the next iteration breaks)
+            if (self._dispatches_remaining() <= 0 or self._unavailable
+                    or self._breaker.state == breaker_mod.OPEN):
                 break
             group = buckets[bucket_level]
             if max_slots is not None and len(group) > max_slots:
@@ -688,17 +773,19 @@ class QueryRouter:
                 break  # host settles the rest — the deadline guarantee
             t0 = time.monotonic()
             try:
-                group_bits = self.backend.try_solve_batch_circuit(
-                    [unit.problem for unit in group],
-                    budget_seconds=remaining,
-                    size_caps=caps,
-                    packed_hint=[unit.pc for unit in group],
-                    **profile,
-                )
+                group_bits = self._guarded_dispatch(
+                    group, remaining, caps, profile)
+            except StageDeadlineExceeded:
+                # wedged backend: the call is abandoned on its runner
+                # thread, the breaker opens HARD, and the host CDCL
+                # settles everything still pending — the query proceeds
+                self.record_deadline_trip()
+                break
             except Exception as error:
                 log.warning("bucketed device dispatch failed (%s); "
                             "CDCL fallback", error)
-                self.record_dispatch(0, time.monotonic() - t0)
+                self.record_dispatch(0, time.monotonic() - t0,
+                                     errored=True)
                 continue
             elapsed = time.monotonic() - t0
             hits = sum(1 for bits in group_bits if bits is not None)

@@ -1,0 +1,403 @@
+"""Chaos suite — the tentpole invariant of the fault-containment layer
+(mythril_tpu/resilience/):
+
+    under every injected fault class, analysis COMPLETES (no hang past
+    the stage deadline, no crash) with findings byte-identical to the
+    no-fault run, and the stats JSON `resilience` section records the
+    matching breaker/retry/quarantine/degraded event.
+
+Each registered fault site (resilience/registry.py) is exercised through
+the pipeline seam the product actually uses: full in-process analyze for
+the engine/solver-side sites, the production batched-solve seam
+(support/model.get_models_batch) for the device sites — the same seam
+test_analyze_routing pins — and an in-process --jobs corpus run for
+worker death. Mechanism-level unit tests live in test_resilience.py;
+this file asserts only the end-to-end property.
+
+Faults are injected via the same path production uses
+(`--inject-fault` -> args.inject_fault -> faults.configure_from_env in
+fire_lasers), so the chaos runs also cover the arming seam itself.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from mythril_tpu import resilience
+from mythril_tpu.resilience import deadline as deadline_mod
+from mythril_tpu.resilience import faults
+from mythril_tpu.smt.solver.statistics import SolverStatistics
+from mythril_tpu.support.args import args as global_args
+
+from tests.test_analysis import KILLBILLY, OVERFLOW_ADD, wrap_creation
+
+
+class _Args:
+    execution_timeout = 60
+    transaction_count = 2
+    max_depth = 128
+    # fork-side pruning rides the coalescing scheduler (one window flush
+    # per exec iteration), so the scheduler.flush site is actually crossed
+    pruning_factor = 1.0
+
+
+def _full_reset():
+    from mythril_tpu import preanalysis
+    from mythril_tpu.support.model import clear_caches
+    from mythril_tpu.tpu import router as router_mod
+
+    clear_caches()  # also drops session fuses (resilience.reset_session)
+    preanalysis.reset_caches()
+    router_mod.reset_router()
+    deadline_mod.reset()
+    faults.configure(None)
+
+
+@pytest.fixture(autouse=True)
+def chaos_env(tmp_path, monkeypatch):
+    """Fresh pipeline state per test: private disk-tier root, disk cache
+    mode (so the disk.entry/disk.write/store.lock sites engage), tpu
+    backend routing, everything cleared before AND after."""
+    monkeypatch.setenv("MYTHRIL_TPU_CACHE_DIR", str(tmp_path / "cache"))
+    monkeypatch.setattr(global_args, "solve_cache", "disk")
+    monkeypatch.setattr(global_args, "solver_backend", "tpu")
+    stats = SolverStatistics()
+    _full_reset()
+    stats.reset()
+    stats.enabled = True
+    yield
+    _full_reset()
+    global_args.inject_fault = None
+    stats.reset()
+
+
+def _canonical(report, exact_witness: bool = True) -> str:
+    """Byte-level canonical findings serialization. With
+    exact_witness=False the solver-CHOSEN witness bytes (each tx step's
+    calldata/value) are masked to their presence: a degradation that
+    lands on a different solver configuration (an individually-retried
+    batch, uncalibrated routing caps) may return a different — equally
+    valid — satisfying model, and the quick-sat model cache then
+    concretizes exploit calldata from it. The FINDINGS (swc, address,
+    function, severity, description, step structure) must still match
+    byte for byte; only the free choice of witness may differ."""
+    issues = json.loads(report.as_json())["issues"]
+    if not exact_witness:
+        for issue in issues:
+            sequence = issue.get("tx_sequence") or {}
+            for step in sequence.get("steps", ()):
+                step["input"] = f"<{len(step.get('input', ''))//2}B>"
+                step["value"] = "<witness>"
+    return json.dumps(
+        sorted(issues, key=lambda i: json.dumps(i, sort_keys=True)),
+        sort_keys=True)
+
+
+def _analyze(code_hex: str, tx_count: int, spec=None,
+             exact_witness: bool = True) -> str:
+    """One full analyze of `code_hex` with the fault harness armed via
+    the production path (args.inject_fault). Returns the canonical
+    byte-level findings serialization."""
+    from mythril_tpu.core import MythrilAnalyzer, MythrilDisassembler
+
+    global_args.inject_fault = spec
+    try:
+        disassembler = MythrilDisassembler()
+        disassembler.load_from_bytecode(code_hex)
+        analyzer = MythrilAnalyzer(disassembler, cmd_args=_Args(),
+                                   strategy="bfs")
+        report = analyzer.fire_lasers(transaction_count=tx_count)
+    finally:
+        global_args.inject_fault = None
+    return _canonical(report, exact_witness=exact_witness)
+
+
+def _events(site: str) -> dict:
+    """The per-site event record from the stats JSON resilience section
+    (the end-to-end emission, not the in-memory dict)."""
+    return SolverStatistics().as_dict()["resilience"]["sites"][site]
+
+
+# baseline findings per (corpus contract, tx count), computed once with a
+# fully fresh pipeline — every faulted run must reproduce these bytes
+_BASELINES = {}
+
+
+def _baseline(code_hex: str, tx_count: int,
+              exact_witness: bool = True) -> str:
+    key = (code_hex, tx_count, exact_witness)
+    if key not in _BASELINES:
+        _full_reset()
+        _BASELINES[key] = _analyze(code_hex, tx_count,
+                                   exact_witness=exact_witness)
+        _full_reset()
+    return _BASELINES[key]
+
+
+# -- analyze-level chaos matrix ------------------------------------------------
+#
+# (site, spec, corpus, tx, events that must reach the stats JSON,
+# exact_witness). KILLBILLY (1 tx) crosses preanalysis.summary,
+# prepare.incremental, aig.session, frontier.step, disk.write and
+# store.lock; OVERFLOW_ADD (2 tx, pruning on) additionally crosses
+# scheduler.flush and device.calibrate. disk.entry needs a warm cache
+# and is covered below. exact_witness=False only where the degradation
+# lands on a different solver CONFIGURATION (see _canonical): there the
+# finding must match byte-for-byte but the witness is a free choice.
+
+ANALYZE_MATRIX = [
+    pytest.param("preanalysis.summary",
+                 "preanalysis.summary:raise:*",
+                 KILLBILLY, 1, ("injected", "degraded"), True,
+                 id="preanalysis.summary-raise"),
+    pytest.param("prepare.incremental",
+                 "prepare.incremental:raise:*",
+                 KILLBILLY, 1, ("injected", "degraded"), True,
+                 id="prepare.incremental-raise"),
+    pytest.param("aig.session",
+                 "aig.session:raise:*",
+                 KILLBILLY, 1, ("injected", "degraded"), True,
+                 id="aig.session-raise"),
+    pytest.param("frontier.step",
+                 "frontier.step:raise:*",
+                 KILLBILLY, 1, ("injected", "degraded"), True,
+                 id="frontier.step-raise"),
+    pytest.param("disk.write",
+                 "disk.write:raise:n1",
+                 KILLBILLY, 1, ("injected", "retry"), True,
+                 id="disk.write-raise"),
+    pytest.param("disk.write",
+                 "disk.write:delay:n1",
+                 KILLBILLY, 1, ("injected",), True,
+                 id="disk.write-delay"),
+    pytest.param("store.lock",
+                 "store.lock:raise:n1",
+                 KILLBILLY, 1, ("injected", "degraded"), True,
+                 id="store.lock-raise"),
+    pytest.param("scheduler.flush",
+                 "scheduler.flush:raise:*",
+                 OVERFLOW_ADD, 2, ("injected", "retry"), False,
+                 id="scheduler.flush-raise"),
+    pytest.param("device.calibrate",
+                 "device.calibrate:raise:n1",
+                 OVERFLOW_ADD, 2, ("injected", "degraded"), False,
+                 id="device.calibrate-raise"),
+]
+
+
+@pytest.mark.parametrize(
+    "site,spec,corpus,tx,expected_events,exact_witness", ANALYZE_MATRIX)
+def test_injected_fault_preserves_findings(site, spec, corpus, tx,
+                                           expected_events,
+                                           exact_witness):
+    baseline = _baseline(wrap_creation(corpus), tx,
+                         exact_witness=exact_witness)
+    _full_reset()
+    SolverStatistics().reset()
+    faulted = _analyze(wrap_creation(corpus), tx, spec=spec,
+                       exact_witness=exact_witness)
+    assert faulted == baseline, \
+        f"findings changed under injected fault {spec}"
+    recorded = _events(site)
+    for event in expected_events:
+        assert recorded.get(event, 0) >= 1, (
+            f"{spec}: expected a {event!r} event at {site} in the stats "
+            f"JSON resilience section, got {recorded}")
+    # provenance: the armed spec is recorded alongside the events
+    assert SolverStatistics().as_dict()["resilience"]["faults_active"] \
+        == spec
+
+
+@pytest.mark.parametrize("spec,quarantines", [
+    pytest.param("disk.entry:corrupt:*", 1, id="disk.entry-corrupt"),
+    pytest.param("disk.entry:raise:n1", 1, id="disk.entry-raise"),
+])
+def test_corrupt_disk_tier_is_safe_miss(spec, quarantines):
+    """disk.entry engages on a WARM cache: run once to populate the disk
+    tier, then re-run (fresh memory tiers, same disk root) with the
+    corruption plan armed — every poisoned lookup must quarantine and
+    degrade to a safe miss, findings unchanged."""
+    code_hex = wrap_creation(KILLBILLY)
+    baseline = _baseline(code_hex, 1)
+    _full_reset()
+    populate = _analyze(code_hex, 1)
+    assert populate == baseline
+    _full_reset()  # drop memory tiers; the disk tier survives
+    SolverStatistics().reset()
+    faulted = _analyze(code_hex, 1, spec=spec)
+    assert faulted == baseline, \
+        f"findings changed under injected fault {spec}"
+    recorded = _events("disk.entry")
+    assert recorded.get("quarantine", 0) >= quarantines, recorded
+    assert SolverStatistics().persistent_verify_rejects >= quarantines
+
+
+def test_multi_site_spec_grammar_end_to_end():
+    """The comma grammar of MYTHRIL_TPU_FAULTS arms several sites in one
+    run; every degradation still lands on the sound path together."""
+    spec = ("preanalysis.summary:raise:n1,aig.session:raise:n1,"
+            "disk.write:delay:n1")
+    code_hex = wrap_creation(KILLBILLY)
+    baseline = _baseline(code_hex, 1)
+    _full_reset()
+    SolverStatistics().reset()
+    assert _analyze(code_hex, 1, spec=spec) == baseline
+    sites = SolverStatistics().as_dict()["resilience"]["sites"]
+    assert sites["preanalysis.summary"].get("injected", 0) == 1
+    assert sites["aig.session"].get("injected", 0) == 1
+    assert sites["disk.write"].get("injected", 0) == 1
+
+
+# -- device dispatch seam (tpu/router.py) --------------------------------------
+#
+# Tiny EASM analyses settle before the router ships anything, so the
+# device.dispatch site is exercised at the production batched-solve seam
+# itself (the same seam test_analyze_routing pins as THE product path):
+# in-calibration production-shape cones that provably reach the device.
+
+
+_seam_salt = [0]
+
+
+def _seam_outcomes():
+    """Production-shape 256-bit cones (the test_analyze_routing mix)
+    through get_models_batch -> router -> device. Salted symbol names per
+    call: a repeat of the same terms would hit the memory/disk result
+    tiers and never reach the dispatch seam under test."""
+    from mythril_tpu.smt import Extract, ULT, symbol_factory
+    from mythril_tpu.support.model import get_models_batch
+
+    _seam_salt[0] += 1
+    salt = _seam_salt[0]
+    queries = []
+    for qi in range(4):
+        data = symbol_factory.BitVecSym(f"chaos_data_{salt}_{qi}", 256)
+        value = symbol_factory.BitVecSym(f"chaos_value_{salt}_{qi}", 256)
+        sender = symbol_factory.BitVecSym(f"chaos_sender_{salt}_{qi}", 256)
+        selector = (0xAB125858 ^ (salt * 0x1010101) ^ qi) & 0xFFFFFFFF
+        queries.append([
+            Extract(255, 224, data)
+            == symbol_factory.BitVecVal(selector, 32),
+            ULT(value, symbol_factory.BitVecVal(1 << 40, 256)),
+            sender != symbol_factory.BitVecVal(0, 256),
+            value + data != sender,
+        ])
+    outcomes = get_models_batch(queries)
+    return [status for status, _model in outcomes]
+
+
+def test_device_dispatch_injected_raise_falls_back_to_host():
+    assert _seam_outcomes() == ["sat"] * 4  # the no-fault seam baseline
+    _full_reset()
+    SolverStatistics().reset()
+    faults.configure("device.dispatch:raise:*")
+    try:
+        assert _seam_outcomes() == ["sat"] * 4, \
+            "host CDCL must settle every query the device path drops"
+    finally:
+        faults.configure(None)
+    recorded = _events("device.dispatch")
+    assert recorded.get("injected", 0) >= 1, recorded
+
+
+def test_device_dispatch_wedged_backend_trips_deadline_and_breaker(
+        monkeypatch):
+    """A hang injection blocks INSIDE the dispatch (the wedged axon
+    tunnel shape): the hard deadline wrapper must abandon the call, the
+    breaker must open HARD, and the host CDCL settles the batch — the
+    query completes, bounded by deadline + grace, never by the hang."""
+    monkeypatch.setenv("MYTHRIL_TPU_ROUND_BUDGET", "0.4")
+    monkeypatch.setenv("MYTHRIL_TPU_STAGE_GRACE", "0.3")
+    from mythril_tpu.tpu import router as router_mod
+
+    router_mod.reset_router()
+    SolverStatistics().reset()
+    faults.configure("device.dispatch:hang:n1")
+    start = time.monotonic()
+    try:
+        assert _seam_outcomes() == ["sat"] * 4
+    finally:
+        faults.configure(None)
+    assert time.monotonic() - start < 30.0, \
+        "the hang leaked past the stage deadline"
+    recorded = _events("device.dispatch")
+    assert recorded.get("deadline", 0) >= 1, recorded
+    assert recorded.get("breaker_trip", 0) >= 1, recorded
+    assert SolverStatistics().resilience_deadline_trips >= 1
+
+
+# -- --jobs worker death (core.py) ---------------------------------------------
+
+
+def _parallel_report(spec=None, jobs=2):
+    from mythril_tpu.core import MythrilAnalyzer, MythrilDisassembler
+
+    global_args.inject_fault = spec
+    global_args.jobs = jobs
+    try:
+        disassembler = MythrilDisassembler()
+        disassembler.load_from_bytecode(wrap_creation(KILLBILLY))
+        disassembler.load_from_bytecode(wrap_creation(OVERFLOW_ADD))
+        analyzer = MythrilAnalyzer(disassembler, cmd_args=_Args(),
+                                   strategy="bfs")
+        report = analyzer.fire_lasers(transaction_count=1)
+    finally:
+        global_args.inject_fault = None
+        global_args.jobs = 1
+    issues = json.loads(report.as_json())["issues"]
+    return json.dumps(
+        sorted(issues, key=lambda i: json.dumps(i, sort_keys=True)),
+        sort_keys=True)
+
+
+@pytest.mark.parametrize("spec,expected_events", [
+    pytest.param("jobs.worker:exit:n1", ("worker_requeue", "degraded"),
+                 id="jobs.worker-exit"),
+    pytest.param("jobs.worker:raise:n1", ("degraded",),
+                 id="jobs.worker-raise"),
+])
+def test_worker_death_requeues_then_degrades_in_process(spec,
+                                                        expected_events):
+    """jobs.worker chaos: `exit` kills every spawned worker at its entry
+    crossing (the OOM/crash shape) — the parent's watchdog must detect
+    the death, requeue the pending contracts into a fresh pool once, and
+    when those workers die too, finish the corpus in-process. `raise`
+    surfaces as a worker exception -> direct sequential fallback. Both
+    runs must land the sequential corpus findings, byte-identical."""
+    baseline = _parallel_report(jobs=1)  # sequential oracle
+    _full_reset()
+    SolverStatistics().reset()
+    faulted = _parallel_report(spec=spec)
+    assert faulted == baseline, \
+        f"findings changed under injected fault {spec}"
+    recorded = _events("jobs.worker")
+    for event in expected_events:
+        assert recorded.get(event, 0) >= 1, (
+            f"{spec}: expected {event!r} at jobs.worker, got {recorded}")
+
+
+# -- completion bound ----------------------------------------------------------
+
+
+def test_every_registered_site_kind_pair_is_exercised_or_unit_tested():
+    """Completeness backstop for the chaos matrix: every (site, kind)
+    pair the registry declares must appear in some spec in this file or
+    in test_resilience.py — a registered kind nothing injects is an
+    untested degradation claim. (tools/check_fault_sites.py enforces the
+    site-level version of this in tier-1; this pins the kind level.)"""
+    from mythril_tpu.resilience import registry
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    text = ""
+    for name in ("test_chaos.py", "test_resilience.py"):
+        with open(os.path.join(here, name), encoding="utf-8") as fd:
+            text += fd.read()
+    specs = set()
+    for site, entry in registry.FAULT_SITES.items():
+        for kind in entry.kinds:
+            if f"{site}:{kind}:" not in text:
+                specs.add(f"{site}:{kind}")
+    assert not specs, \
+        f"registered fault kinds no chaos/unit test injects: {sorted(specs)}"

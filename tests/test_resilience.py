@@ -1,0 +1,602 @@
+"""Unit tests for the fault-containment layer (mythril_tpu/resilience/):
+breaker state machine, hard-deadline wrapper, fault-injection harness
+determinism, jittered retries + session fuses, stale-lock breaking
+(support/lock.py), coalesced-flush query isolation (service/scheduler.py),
+and cache-corruption quarantine (service/store.py). The end-to-end
+invariant — injected faults never change findings — lives in
+tests/test_chaos.py; these tests pin each mechanism in isolation."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from mythril_tpu import resilience
+from mythril_tpu.resilience import breaker as breaker_mod
+from mythril_tpu.resilience import deadline as deadline_mod
+from mythril_tpu.resilience import faults
+from mythril_tpu.resilience.breaker import StageBreaker
+from mythril_tpu.smt.solver.statistics import SolverStatistics
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def fresh_resilience_state():
+    stats = SolverStatistics()
+    stats.reset()
+    stats.enabled = True
+    resilience.reset_session()
+    faults.configure(None)
+    yield
+    faults.configure(None)
+    resilience.reset_session()
+    deadline_mod.reset()
+    stats.reset()
+
+
+# -- event accounting ---------------------------------------------------------
+
+
+def test_record_event_bumps_scalar_and_per_site():
+    resilience.record_event("disk.entry", "quarantine")
+    resilience.record_event("disk.entry", "quarantine")
+    resilience.record_event("device.dispatch", "breaker_trip")
+    stats = SolverStatistics()
+    assert stats.resilience_quarantines == 2
+    assert stats.resilience_breaker_trips == 1
+    assert stats.resilience_events["disk.entry"]["quarantine"] == 2
+    assert stats.resilience_events["device.dispatch"]["breaker_trip"] == 1
+
+
+def test_resilience_section_zero_filled_and_absorbed():
+    """The stats JSON resilience section lists EVERY registered site
+    (stable shape), and per-site events survive the --jobs absorb merge
+    like the scalar counters do."""
+    from mythril_tpu.resilience import registry
+
+    resilience.record_event("scheduler.flush", "retry")
+    stats = SolverStatistics()
+    out = stats.as_dict()
+    assert set(registry.FAULT_SITES) <= set(out["resilience"]["sites"])
+    assert out["resilience"]["sites"]["scheduler.flush"]["retry"] == 1
+    # a worker snapshot merges per-site events and scalars
+    stats.absorb({
+        "resilience_retries": 3,
+        "resilience": {"sites": {"scheduler.flush": {"retry": 3}}},
+    })
+    assert stats.resilience_retries == 4
+    assert stats.resilience_events["scheduler.flush"]["retry"] == 4
+
+
+# -- breaker ------------------------------------------------------------------
+
+
+def test_breaker_opens_on_count_threshold_and_reprobes():
+    breaker = StageBreaker("device.dispatch", failure_threshold=3,
+                           cooldown_s=0.05)
+    for _ in range(2):
+        breaker.record_failure()
+        assert breaker.allow()
+    breaker.record_failure()
+    assert breaker.state == breaker_mod.OPEN
+    assert not breaker.allow(), "open breaker refuses during cooldown"
+    time.sleep(0.06)
+    assert breaker.allow(), "cooldown elapsed: one half-open probe admitted"
+    assert breaker.state == breaker_mod.HALF_OPEN
+    assert not breaker.allow(), "only ONE probe in flight"
+    breaker.record_success()
+    assert breaker.state == breaker_mod.CLOSED
+    assert breaker.failures == 0
+
+
+def test_breaker_reprobe_failure_reopens():
+    breaker = StageBreaker("device.dispatch", failure_threshold=1,
+                           cooldown_s=0.05)
+    breaker.record_failure()
+    time.sleep(0.06)
+    assert breaker.allow()
+    breaker.record_failure()
+    assert breaker.state == breaker_mod.OPEN
+    assert not breaker.allow()
+
+
+def test_breaker_outcome_less_probe_admission_expires():
+    """Regression: a half-open probe admission whose caller never
+    reports an outcome (admitted, then found no eligible work to
+    dispatch) must EXPIRE after another cooldown — not leave the stage
+    off for the rest of the process."""
+    breaker = StageBreaker("device.dispatch", failure_threshold=1,
+                           cooldown_s=0.05)
+    breaker.record_failure()
+    time.sleep(0.06)
+    assert breaker.allow(), "cooldown elapsed: probe admitted"
+    # ...but the caller dispatches nothing and records no outcome
+    assert not breaker.allow(), "probe still notionally in flight"
+    time.sleep(0.06)
+    assert breaker.allow(), "outcome-less probe expired: fresh probe"
+    breaker.record_success()
+    assert breaker.state == breaker_mod.CLOSED
+
+
+def test_breaker_half_open_zero_hit_probe_does_not_retrip():
+    """Regression: a clean zero-hit probe dispatch (count=False — a
+    legitimate outcome on an UNSAT-heavy stretch) must NOT re-open the
+    breaker; only an errored/hard probe or the (trip-reset) waste budget
+    may. Otherwise a model-free workload makes the breaker terminal."""
+    breaker = StageBreaker("device.dispatch", failure_threshold=1,
+                           waste_budget_s=1.0, cooldown_s=0.05)
+    breaker.record_failure()  # opens (threshold 1); meters reset on trip
+    time.sleep(0.06)
+    assert breaker.allow(), "probe admitted"
+    breaker.record_failure(wasted_s=0.2, count=False)  # clean zero-hit
+    assert breaker.state == breaker_mod.HALF_OPEN, \
+        "zero-hit probe is not an error: stays half-open"
+    breaker.record_success()
+    assert breaker.state == breaker_mod.CLOSED
+
+
+def test_spec_rejects_duplicate_site():
+    """A spec naming a site twice must fail loudly — a silently dropped
+    plan would make its chaos assertions vacuous."""
+    with pytest.raises(ValueError):
+        faults.parse_spec("disk.entry:corrupt:n1,disk.entry:raise:n2")
+
+
+def test_orphaned_inode_flock_is_not_mutual_exclusion(tmp_path, monkeypatch):
+    """Regression for the uncoordinated double-break: when a sibling
+    breaks the (stale) lock between our open and our flock, our flock
+    succeeds on the ORPHANED inode and means nothing — acquire must
+    detect the inode mismatch and re-contend on the path's current inode
+    instead of entering the critical section alongside the breaker."""
+    import fcntl
+
+    from mythril_tpu.support.lock import LockFile
+
+    path = str(tmp_path / "store.lock")
+    lock = LockFile(path, timeout_seconds=0.5)
+    real_flock = fcntl.flock
+    raced = []
+
+    def racing_flock(handle, flags):
+        result = real_flock(handle, flags)
+        if not raced and flags & fcntl.LOCK_EX:
+            # sibling breaks the lock right after our flock lands: the
+            # path now points at a fresh, unlocked inode
+            raced.append(True)
+            os.unlink(path)
+            open(path, "a+").close()
+        return result
+
+    monkeypatch.setattr(fcntl, "flock", racing_flock)
+    lock.acquire()
+    assert lock._holds_current_inode(), \
+        "acquire settled on the path's CURRENT inode, not the orphan"
+    assert SolverStatistics().resilience_degraded == 0
+    lock.release()
+
+
+def test_router_zero_waste_budget_means_zero_tolerance():
+    """Regression: MYTHRIL_TPU_DEVICE_MAX_WASTE=0 must trip the breaker
+    on the FIRST fruitless dispatch (the pre-resilience semantics), not
+    silently disable the waste budget (0.0 is falsy in the breaker)."""
+    from mythril_tpu.tpu.router import QueryRouter
+    from tests.test_router import FakeBackend
+
+    router = QueryRouter(FakeBackend())
+    router.max_waste_s = 0.0
+    assert router._waste_budget() > 0.0
+    router.record_dispatch(hits=0, seconds=0.01)
+    assert router._breaker.state == breaker_mod.OPEN
+
+
+def test_breaker_hard_failure_trips_immediately():
+    breaker = StageBreaker("device.dispatch", failure_threshold=99,
+                           cooldown_s=60.0)
+    breaker.record_failure(hard=True)
+    assert breaker.state == breaker_mod.OPEN
+    assert SolverStatistics().resilience_breaker_trips == 1
+
+
+def test_breaker_waste_budget_without_error_counting():
+    """A zero-hit dispatch is a legitimate outcome: count=False must
+    charge only the waste budget, never the failure count."""
+    breaker = StageBreaker("device.dispatch", failure_threshold=1,
+                           waste_budget_s=1.0, cooldown_s=60.0)
+    breaker.record_failure(wasted_s=0.6, count=False)
+    assert breaker.state == breaker_mod.CLOSED
+    assert breaker.failures == 0
+    breaker.record_failure(wasted_s=0.6, count=False)
+    assert breaker.state == breaker_mod.OPEN, "waste budget burned"
+
+
+# -- hard deadline wrapper ----------------------------------------------------
+
+
+def test_deadline_returns_value_and_propagates_exceptions():
+    assert deadline_mod.run_with_deadline(
+        "device.dispatch", lambda: 41 + 1, 5.0) == 42
+    with pytest.raises(ValueError):
+        deadline_mod.run_with_deadline(
+            "device.dispatch", lambda: (_ for _ in ()).throw(
+                ValueError("inner")), 5.0)
+
+
+def test_deadline_trips_on_wedged_call_and_recovers():
+    start = time.monotonic()
+    with pytest.raises(deadline_mod.StageDeadlineExceeded):
+        deadline_mod.run_with_deadline(
+            "device.dispatch", lambda: time.sleep(30.0), 0.1)
+    assert time.monotonic() - start < 5.0, "rescued at the deadline"
+    assert SolverStatistics().resilience_deadline_trips == 1
+    # the wedged runner is abandoned: the NEXT call gets a fresh runner
+    # and cannot receive the stale sleeper's (discarded) result
+    assert deadline_mod.run_with_deadline(
+        "device.dispatch", lambda: "fresh", 5.0) == "fresh"
+
+
+def test_nonpositive_deadline_runs_inline():
+    assert deadline_mod.run_with_deadline("x", lambda: 7, 0) == 7
+    assert deadline_mod.run_with_deadline("x", lambda: 7, -1.0) == 7
+
+
+# -- fault-injection harness ---------------------------------------------------
+
+
+def test_spec_parse_rejects_unknown_site_kind_trigger():
+    with pytest.raises(ValueError):
+        faults.parse_spec("no.such.site:raise:n1")
+    with pytest.raises(ValueError):
+        faults.parse_spec("disk.entry:hang:n1")  # kind not meaningful there
+    with pytest.raises(ValueError):
+        faults.parse_spec("disk.entry:raise:whenever")
+    with pytest.raises(ValueError):
+        faults.parse_spec("disk.entry:raise")
+
+
+def test_nth_trigger_fires_exactly_once():
+    faults.configure("prepare.incremental:raise:n3")
+    fired = 0
+    for _ in range(6):
+        try:
+            faults.maybe_inject("prepare.incremental")
+        except faults.InjectedFault:
+            fired += 1
+    assert fired == 1
+    assert SolverStatistics().resilience_faults_injected == 1
+
+
+def test_rate_trigger_reproducible_under_seed(monkeypatch):
+    monkeypatch.setenv(faults.SEED_ENV, "7")
+
+    def schedule():
+        faults.configure("prepare.incremental:raise:r0.5")
+        hits = []
+        for i in range(32):
+            try:
+                faults.maybe_inject("prepare.incremental")
+                hits.append(False)
+            except faults.InjectedFault:
+                hits.append(True)
+        return hits
+
+    first, second = schedule(), schedule()
+    assert first == second, "same seed, same fault schedule"
+    assert any(first) and not all(first)
+
+
+def test_corrupt_plan_acts_only_on_data_path():
+    faults.configure("disk.entry:corrupt:n1")
+    # control-path crossings must not consume the data-path trigger
+    faults.maybe_inject("disk.entry")
+    faults.maybe_inject("disk.entry")
+    mangled = faults.corrupt_text("disk.entry", '{"ok": true}')
+    assert mangled != '{"ok": true}'
+    assert faults.corrupt_text("disk.entry", "later") == "later", \
+        "n1 fired exactly once"
+
+
+def test_active_spec_reaches_stats_json():
+    faults.configure("disk.entry:corrupt:n1")
+    assert SolverStatistics().as_dict()["resilience"]["faults_active"] \
+        == "disk.entry:corrupt:n1"
+
+
+def test_disarmed_injection_overhead_under_budget():
+    """The chaos acceptance bound: disabled-path injection hooks stay
+    under the tracer's 2%-of-stress-wall budget (~20 µs per crossing on
+    a 1e5-crossing stress leg). Disarmed maybe_inject is one global load
+    and a None check — hold it to the same generous 10 µs ceiling the
+    tracer's guard uses."""
+    faults.configure(None)
+    n = 50_000
+    start = time.perf_counter()
+    for _ in range(n):
+        faults.maybe_inject("device.dispatch")
+    per_crossing_us = (time.perf_counter() - start) * 1e6 / n
+    assert per_crossing_us < 10.0, (
+        f"disarmed maybe_inject costs {per_crossing_us:.2f}µs per "
+        "crossing — over the 2%-of-stress-wall budget")
+
+
+# -- retries + session fuses ---------------------------------------------------
+
+
+def test_with_retries_retries_then_succeeds():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) == 1:
+            raise OSError("transient")
+        return "ok"
+
+    assert resilience.with_retries("disk.write", flaky,
+                                   base_delay_s=0.0001) == "ok"
+    assert len(calls) == 2
+    assert SolverStatistics().resilience_retries == 1
+
+
+def test_with_retries_exhaustion_propagates():
+    def always():
+        raise OSError("permanent")
+
+    with pytest.raises(OSError):
+        resilience.with_retries("disk.write", always, attempts=3,
+                                base_delay_s=0.0001)
+    assert SolverStatistics().resilience_retries == 2
+
+
+def test_session_fuse_blows_on_deterministic_fault():
+    site = "aig.session"
+    assert not resilience.fuse_blown(site)
+    for i in range(resilience.FUSE_THRESHOLD):
+        blew = resilience.note_stage_failure(site)
+    assert blew, "threshold reached: fuse blows"
+    assert resilience.fuse_blown(site)
+    stats = SolverStatistics()
+    assert stats.resilience_degraded == resilience.FUSE_THRESHOLD
+    resilience.reset_session()
+    assert not resilience.fuse_blown(site)
+
+
+def test_hard_stage_failure_blows_fuse_immediately():
+    assert resilience.note_stage_failure("device.calibrate", hard=True)
+    assert resilience.fuse_blown("device.calibrate")
+
+
+# -- stale lock breaking (support/lock.py) --------------------------------------
+
+
+def _flock_holder(path):
+    import fcntl
+
+    handle = open(path, "a+")
+    fcntl.flock(handle, fcntl.LOCK_EX | fcntl.LOCK_NB)
+    return handle
+
+
+def _dead_pid() -> int:
+    proc = subprocess.Popen([sys.executable, "-c", "pass"])
+    proc.wait()
+    return proc.pid
+
+
+def test_stale_lock_broken_when_owner_pid_dead(tmp_path):
+    """Regression for the crashed-worker deadlock: a lock whose recorded
+    owner is dead is broken (unlinked + re-taken on a fresh inode)
+    instead of stalling every later store/calibration access."""
+    from mythril_tpu.support.lock import LockFile
+
+    path = str(tmp_path / "store.lock")
+    holder = _flock_holder(path)  # flock conflicts even intra-process
+    holder.write(f"{_dead_pid()} {int(time.time())}\n")
+    holder.flush()
+    lock = LockFile(path, timeout_seconds=30.0)
+    start = time.monotonic()
+    lock.acquire()
+    assert time.monotonic() - start < 5.0, "broke the stale lock, fast"
+    assert SolverStatistics().resilience_stale_lock_breaks == 1
+    lock.release()
+    holder.close()
+
+
+def test_stale_lock_broken_by_max_age(tmp_path):
+    from mythril_tpu.support.lock import LockFile
+
+    path = str(tmp_path / "store.lock")
+    holder = _flock_holder(path)
+    holder.write(f"{os.getpid()} {int(time.time())}\n")  # owner "alive"
+    holder.flush()
+    old = time.time() - 3600
+    os.utime(path, (old, old))
+    lock = LockFile(path, timeout_seconds=30.0, stale_age_seconds=60.0)
+    start = time.monotonic()
+    lock.acquire()
+    assert time.monotonic() - start < 5.0
+    assert SolverStatistics().resilience_stale_lock_breaks == 1
+    lock.release()
+    holder.close()
+
+
+def test_live_fresh_holder_is_not_broken(tmp_path):
+    """A live, recent holder must NOT be stolen: acquire waits out its
+    timeout and then degrades to proceeding unlocked (atomic renames keep
+    unlocked writers safe), counting the degradation."""
+    from mythril_tpu.support.lock import LockFile
+
+    path = str(tmp_path / "store.lock")
+    holder = _flock_holder(path)
+    holder.write(f"{os.getpid()} {int(time.time())}\n")
+    holder.flush()
+    lock = LockFile(path, timeout_seconds=0.3)
+    lock.acquire()  # returns (degraded), does not deadlock
+    assert SolverStatistics().resilience_stale_lock_breaks == 0
+    assert SolverStatistics().resilience_degraded == 1
+    lock.release()
+    holder.close()
+
+
+def test_lock_normal_acquire_release(tmp_path):
+    from mythril_tpu.support.lock import LockFile
+
+    path = str(tmp_path / "plain.lock")
+    with LockFile(path) as lock:
+        assert lock._handle is not None
+        with open(path) as fd:
+            assert int(fd.read().split()[0]) == os.getpid()
+    assert SolverStatistics().resilience_degraded == 0
+
+
+# -- coalesced flush isolation (service/scheduler.py) ---------------------------
+
+
+def test_flush_failure_poisons_only_the_failing_query(monkeypatch):
+    """A query raising inside a coalesced flush must fail ONLY its own
+    handle: the window is retried query-by-query, siblings get their real
+    verdicts, and only the lone failure degrades to unknown."""
+    from mythril_tpu.service.scheduler import CoalescingScheduler
+    from mythril_tpu.support import model as model_mod
+
+    poison = ["BAD"]
+
+    def fake_get_models_batch(constraint_sets, crosscheck=None):
+        if any(cs == poison for cs in constraint_sets):
+            raise RuntimeError("poisoned query")
+        return [("sat", object()) for _ in constraint_sets]
+
+    monkeypatch.setattr(model_mod, "get_models_batch",
+                        fake_get_models_batch)
+    scheduler = CoalescingScheduler()
+    scheduler.window_ms = 1000.0  # coalescing on, no age flush mid-test
+    scheduler.max_batch = 16
+    good_a = scheduler.submit(["A"])
+    bad = scheduler.submit(poison)
+    good_b = scheduler.submit(["B"])
+    scheduler.flush()
+    assert good_a.result()[0] == "sat"
+    assert good_b.result()[0] == "sat"
+    assert bad.result() == ("unknown", None)
+    stats = SolverStatistics()
+    assert stats.resilience_events["scheduler.flush"]["retry"] == 1
+    assert stats.resilience_events["scheduler.flush"]["degraded"] == 1
+
+
+def test_flush_success_path_untouched(monkeypatch):
+    from mythril_tpu.service.scheduler import CoalescingScheduler
+    from mythril_tpu.support import model as model_mod
+
+    calls = []
+
+    def fake_get_models_batch(constraint_sets, crosscheck=None):
+        calls.append(len(constraint_sets))
+        return [("unsat", None) for _ in constraint_sets]
+
+    monkeypatch.setattr(model_mod, "get_models_batch",
+                        fake_get_models_batch)
+    scheduler = CoalescingScheduler()
+    scheduler.window_ms = 1000.0
+    handles = [scheduler.submit([f"q{i}"]) for i in range(3)]
+    scheduler.flush()
+    assert calls == [3], "one batched call, no per-query retries"
+    assert all(h.result() == ("unsat", None) for h in handles)
+    assert SolverStatistics().resilience_events.get("scheduler.flush") \
+        is None
+
+
+# -- cache-corruption quarantine (service/store.py) ------------------------------
+
+
+def _store(tmp_path):
+    from mythril_tpu.service.store import PersistentResultStore
+
+    return PersistentResultStore(root=str(tmp_path / "solve-cache"))
+
+
+def _fingerprint_path(store, fingerprint):
+    return store._path(fingerprint)
+
+
+@pytest.mark.parametrize("mangle", [
+    pytest.param(lambda text: text[: len(text) // 2], id="truncated"),
+    pytest.param(lambda text: "\x00\xff garbage not json", id="garbage"),
+    pytest.param(
+        lambda text: json.dumps(
+            dict(json.loads(text), schema=999)), id="wrong-version"),
+    pytest.param(
+        lambda text: json.dumps(
+            dict(json.loads(text), bits="!!!not-base64!!!")),
+        id="bad-blob"),
+])
+def test_corrupt_entry_quarantined_and_safe_miss(tmp_path, mangle):
+    """Satellite invariant: truncated / garbage / wrong-VERSION /
+    undecodable entries count a persistent_verify_reject, are moved to a
+    `.quarantined` sibling (never re-read), and the lookup proceeds as a
+    safe miss — the oracle recomputes, findings cannot change."""
+    store = _store(tmp_path)
+    fingerprint = "cafe" * 16
+    assert store.store_sat(fingerprint, 8, [True] * 9)
+    path = _fingerprint_path(store, fingerprint)
+    with open(path) as fd:
+        text = fd.read()
+    with open(path, "w") as fd:
+        fd.write(mangle(text))
+
+    before = SolverStatistics().persistent_verify_rejects
+    assert store.lookup(fingerprint) is None, "safe miss, not a crash"
+    assert SolverStatistics().persistent_verify_rejects == before + 1
+    assert SolverStatistics().resilience_quarantines == 1
+    assert not os.path.exists(path), "corrupt entry moved aside"
+    assert os.path.exists(path + ".quarantined"), "kept for forensics"
+    assert store.lookup(fingerprint) is None, "quarantined: never re-read"
+    assert SolverStatistics().resilience_quarantines == 1
+
+
+def test_quarantine_corpses_bounded(tmp_path):
+    """Regression: a recurring corruption source must not grow the cache
+    dir without bound through .quarantined files the eviction sweep does
+    not see — only the newest _QUARANTINE_KEEP corpses are kept."""
+    store = _store(tmp_path)
+    keep = store._QUARANTINE_KEEP
+    now = time.time()
+    for i in range(keep + 5):
+        fingerprint = f"{i:04x}" * 16
+        assert store.store_unsat(fingerprint, crosschecked=True)
+        path = _fingerprint_path(store, fingerprint)
+        with open(path, "w") as fd:
+            fd.write("garbage")
+        # distinct mtimes so the prune order is deterministic
+        os.utime(path, (now - (keep + 5) + i, now - (keep + 5) + i))
+        assert store.lookup(fingerprint) is None
+    corpses = [name for name in os.listdir(store.root)
+               if name.endswith(".quarantined")]
+    assert len(corpses) == keep
+    assert f"{keep + 4:04x}" * 16 + ".json.quarantined" in corpses, \
+        "the newest corpse survives the prune"
+
+
+def test_healthy_entry_roundtrip_unaffected(tmp_path):
+    store = _store(tmp_path)
+    fingerprint = "beef" * 16
+    bits = [True, False] * 4 + [True]
+    assert store.store_sat(fingerprint, 8, bits)
+    entry = store.lookup(fingerprint)
+    assert entry is not None and entry.verdict == "sat"
+    assert entry.bits == bits
+    assert SolverStatistics().resilience_quarantines == 0
+
+
+def test_injected_disk_write_fault_retries(tmp_path, monkeypatch):
+    """disk.write is a retry site: a transient write fault costs one
+    jittered retry, not the entry."""
+    store = _store(tmp_path)
+    faults.configure("disk.write:raise:n1")
+    assert store.store_unsat("feed" * 16, crosschecked=True)
+    stats = SolverStatistics()
+    assert stats.resilience_retries == 1
+    assert stats.resilience_faults_injected == 1
+    entry = store.lookup("feed" * 16)
+    assert entry is not None and entry.verdict == "unsat"

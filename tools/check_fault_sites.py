@@ -1,0 +1,177 @@
+#!/usr/bin/env python
+"""Repo lint: the fault-site registry must stay LOAD-BEARING (mirrors
+tools/check_stats_keys.py for telemetry and check_env_docs.py for env
+vars).
+
+A resilience claim that is registered but not wired, or wired but not
+chaos-tested, is exactly the "we handle failures there" folklore the
+typed registry exists to kill. Five invariants:
+
+  1. the registry itself is structurally valid (every site declares a
+     known degradation action, at least one injection kind, and a
+     degradation description) — registry.validate();
+  2. every registered fault site is WIRED: its name appears as a
+     maybe_inject("<site>")/corrupt_text("<site>"/run_with_deadline(
+     "<site>" crossing somewhere under mythril_tpu/ — a site the code
+     never crosses can never degrade, so its chaos tests are vacuous;
+  3. every registered fault site is EXERCISED by the chaos/resilience
+     suite: its name appears in tests/test_chaos.py or
+     tests/test_resilience.py;
+  4. every crossing in the code names a REGISTERED site (no orphan
+     maybe_inject("typo.site") silently injecting nothing);
+  5. every resilience event counter rolls up end to end: each scalar in
+     SolverStatistics._RESILIENCE_EVENT_COUNTERS.values() must be a
+     _COUNTERS member, appear in the as_dict() stats-JSON emission, and
+     have a bench.py ROUTING_KEYS row; as_dict() must emit the
+     "resilience" section with every registered site present (the
+     zero-filled stable shape the chaos suite and post-hoc diffing
+     key on), and every literal record_event(site, event) in the code
+     must use a known event name.
+
+Exits 1 listing the violations. Wired into tier-1 via
+tests/test_fault_sites.py.
+
+Usage: python tools/check_fault_sites.py [repo_root]
+"""
+
+import importlib.util
+import os
+import re
+import sys
+
+# any registered-site crossing the code can make: injection hooks, the
+# data-path corrupt hook, and the hard-deadline wrapper
+_CROSSING_RE = re.compile(
+    r'(?:maybe_inject|corrupt_text|run_with_deadline)\(\s*"([a-z_.]+)"')
+_EVENT_RE = re.compile(
+    r'record_event\(\s*"([a-z_.]+)",\s*"([a-z_]+)"')
+
+
+def _load_bench(repo_root: str):
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(repo_root, "bench.py"))
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def _python_files(root: str):
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for name in filenames:
+            if name.endswith(".py"):
+                yield os.path.join(dirpath, name)
+
+
+def main(argv) -> int:
+    root = os.path.abspath(
+        argv[1] if len(argv) > 1
+        else os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+    )
+    sys.path.insert(0, root)
+    from mythril_tpu.resilience import registry
+    from mythril_tpu.smt.solver.statistics import SolverStatistics
+
+    failures = []
+
+    # 1. structural validity
+    try:
+        registry.validate()
+    except AssertionError as error:
+        failures.append(f"registry invalid: {error}")
+
+    # 2./4. wiring: crossings in the package vs the registry
+    package_root = os.path.join(root, "mythril_tpu")
+    crossings = {}
+    events_used = set()
+    for path in _python_files(package_root):
+        if os.sep + "resilience" + os.sep in path:
+            continue  # the framework itself, not a wired stage
+        with open(path, encoding="utf-8") as fd:
+            text = fd.read()
+        for site in _CROSSING_RE.findall(text):
+            crossings.setdefault(site, []).append(
+                os.path.relpath(path, root))
+        events_used.update(_EVENT_RE.findall(text))
+    unwired = sorted(set(registry.FAULT_SITES) - set(crossings))
+    if unwired:
+        failures.append(
+            "registered fault sites never crossed under mythril_tpu/ "
+            "(no maybe_inject/corrupt_text/run_with_deadline): "
+            + ", ".join(unwired))
+    orphans = sorted(set(crossings) - set(registry.FAULT_SITES))
+    if orphans:
+        failures.append(
+            "code crosses UNREGISTERED fault sites (typo or missing "
+            "registry entry): " + ", ".join(
+                f"{site} ({crossings[site][0]})" for site in orphans))
+
+    # 3. chaos coverage: every site named in the chaos/resilience suite
+    tested = set()
+    for test_name in ("test_chaos.py", "test_resilience.py"):
+        test_path = os.path.join(root, "tests", test_name)
+        if not os.path.isfile(test_path):
+            continue
+        with open(test_path, encoding="utf-8") as fd:
+            text = fd.read()
+        for site in registry.FAULT_SITES:
+            if f'"{site}"' in text:
+                tested.add(site)
+    untested = sorted(set(registry.FAULT_SITES) - tested)
+    if untested:
+        failures.append(
+            "registered fault sites with no chaos test naming them "
+            "(tests/test_chaos.py / tests/test_resilience.py): "
+            + ", ".join(untested))
+
+    # 5. counter roll-up end to end
+    bench = _load_bench(root)
+    event_counters = SolverStatistics._RESILIENCE_EVENT_COUNTERS
+    counters = set(SolverStatistics._COUNTERS)
+    emitted_dict = SolverStatistics().as_dict()
+    routed = {stats_key for stats_key, _report_key in bench.ROUTING_KEYS}
+    for event, counter in sorted(event_counters.items()):
+        if counter not in counters:
+            failures.append(
+                f"resilience event {event!r} rolls up into {counter!r}, "
+                "which is not a SolverStatistics._COUNTERS member")
+        if counter not in emitted_dict:
+            failures.append(
+                f"resilience counter {counter!r} missing from the "
+                "MYTHRIL_TPU_STATS_JSON emission (as_dict)")
+        if counter not in routed:
+            failures.append(
+                f"resilience counter {counter!r} missing from bench.py "
+                "ROUTING_KEYS roll-up")
+    resilience_section = emitted_dict.get("resilience")
+    if not isinstance(resilience_section, dict) \
+            or "sites" not in resilience_section:
+        failures.append(
+            'as_dict() does not emit the "resilience" section')
+    else:
+        missing_sites = sorted(
+            set(registry.FAULT_SITES)
+            - set(resilience_section["sites"]))
+        if missing_sites:
+            failures.append(
+                'stats JSON "resilience" section is missing registered '
+                "sites (shape must be stable): " + ", ".join(missing_sites))
+    unknown_events = sorted(
+        {event for _site, event in events_used} - set(event_counters))
+    if unknown_events:
+        failures.append(
+            "record_event() called with event names no counter rolls up: "
+            + ", ".join(unknown_events))
+
+    if failures:
+        print("FAIL: the fault-site registry is not load-bearing:",
+              file=sys.stderr)
+        for failure in failures:
+            print(f"  {failure}", file=sys.stderr)
+        return 1
+    print(f"ok: {len(registry.FAULT_SITES)} fault sites — all declared, "
+          "wired, chaos-tested, and rolled up")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
