@@ -214,6 +214,12 @@ def add_analysis_args(parser) -> None:
                              "straight-line opcode runs as one device "
                              "step); env override: "
                              "MYTHRIL_TPU_VMAP_FRONTIER=0|1")
+    parser.add_argument("--no-ragged", action="store_true",
+                        dest="no_ragged",
+                        help="disable ragged paged device dispatch and the "
+                             "cube-and-conquer second pass, restoring the "
+                             "level-bucketed padded dispatch; env "
+                             "override: MYTHRIL_TPU_RAGGED=0|1")
     parser.add_argument("--trace", metavar="PATH", default=None,
                         help="write a Chrome-trace-event / Perfetto span "
                              "timeline of the whole pipeline (analyze, "

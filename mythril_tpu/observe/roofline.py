@@ -39,15 +39,21 @@ from typing import Optional
 
 log = logging.getLogger(__name__)
 
-# the four device-path stages with measured work, busy wall, and a
-# calibrated ceiling. One tuple drives build(), the check_stats_keys lint,
-# and bench.py's ROOFLINE_STAGES gap table — adding a stage is one entry
-# here plus its work/rate wiring below.
-STAGES = ("pack", "ship", "kernel", "settle")
+# the device-path stages with measured work, busy wall, and a calibrated
+# ceiling. One tuple drives build(), the check_stats_keys lint, and
+# bench.py's ROOFLINE_STAGES gap table — adding a stage is one entry
+# here plus its work/rate wiring below. "ragged" is the flat-stream
+# assembly + upload of the ragged paged dispatch (circuit.RaggedStream:
+# work = paged_stream_bytes, busy = the backend's ragged_seconds),
+# the pack/ship counterpart of that path — its ceiling comes from the
+# router micro-calibration's two-cone stream measurement
+# (ragged_bytes_s, persisted with the calibration profile).
+STAGES = ("pack", "ship", "ragged", "kernel", "settle")
 
 _UNITS = {
     "pack": "bytes/s",
     "ship": "bytes/s",
+    "ragged": "bytes/s",
     "kernel": "cells/s",
     "settle": "clauses/s",
 }
@@ -123,6 +129,11 @@ def _build(stats) -> dict:
             device.get("ship_seconds", 0.0),
             rates.get("ship_bytes_s"),
             _UNITS["ship"]),
+        "ragged": _stage_row(
+            device.get("paged_stream_bytes", 0),
+            device.get("ragged_seconds", 0.0),
+            rates.get("ragged_bytes_s"),
+            _UNITS["ragged"]),
         "kernel": _stage_row(
             device.get("cells_stepped", 0),
             device.get("solve_seconds", 0.0),

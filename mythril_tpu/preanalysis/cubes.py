@@ -1,0 +1,71 @@
+"""Cube-and-conquer split-variable selection over packed cones.
+
+Classic cube-and-conquer splits a hard SAT instance on a few carefully
+chosen variables into 2^k cubes (one per sign pattern) that are solved
+independently; the lookahead literature picks split variables by how
+much of the instance each one touches. Here the selection reuses the
+variable-incidence view the PR-4 partitioning passes introduced
+(preanalysis/components.py builds connectivity from exactly these
+variable-gate edges): a PackedCircuit's per-var gate tables ga_var /
+gb_var ARE the variable->gate incidence of the cone, so degree
+centrality — how many gates read an input variable directly — is one
+numpy bincount, no graph library needed. High-fanout inputs (selector
+bytes, the callvalue word's low bits) gate the most downstream
+structure, so pinning them both splits the search space evenly and
+shortens every justification walk that would otherwise re-derive them.
+
+The cubes ride the device as extra asserted roots on a ragged stream
+(tpu/circuit.RaggedStream `extra_roots`): every cube is the ORIGINAL
+cone plus pinned input literals, so any model the kernel finds for any
+cube is a model of the original query — soundness needs no new
+machinery. The device cannot refute: cubes that come back modelless are
+candidate refutations only, and the host CDCL remains the per-cube
+fallback and the sole UNSAT oracle (the standard crosscheck policy).
+"""
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+Cube = List[Tuple[int, bool]]  # [(local input var, pinned value), ...]
+
+
+def select_cube_vars(pc, k: int) -> List[int]:
+    """The top-`k` cone INPUT variables by degree centrality in the
+    variable-gate incidence graph (direct fanout: gates whose fanin
+    tables name the variable). Deterministic: ties break toward the
+    lower variable id, so repeated dispatches cube identically."""
+    if k <= 0 or not getattr(pc, "ok", False):
+        return []
+    fanout = (np.bincount(pc.ga_var, minlength=pc.v1)
+              + np.bincount(pc.gb_var, minlength=pc.v1))
+    is_input = pc.is_gate == 0
+    is_input[0] = False  # the shared constant is not splittable
+    candidates = np.nonzero(is_input & (fanout > 0))[0]
+    if candidates.size == 0:
+        return []
+    order = np.lexsort((candidates, -fanout[candidates]))
+    return [int(v) for v in candidates[order][:k]]
+
+
+def enumerate_cubes(split_vars: Sequence[int]) -> List[Cube]:
+    """All 2^k sign patterns over `split_vars` — the cube set. Empty
+    selection yields no cubes (the caller keeps the un-split cone)."""
+    if not split_vars:
+        return []
+    cubes: List[Cube] = []
+    for pattern in range(1 << len(split_vars)):
+        cubes.append([(var, bool((pattern >> i) & 1))
+                      for i, var in enumerate(split_vars)])
+    return cubes
+
+
+def plan_cubes(pc, cube_vars: int, max_cubes: int) -> List[Cube]:
+    """Cube plan for one packed cone, bounded by `max_cubes` (the
+    caller's memory/variable budget for replicating the cone onto a
+    ragged stream): the split width shrinks until 2^k fits, and a
+    budget under 2 cubes means the cone ships un-split."""
+    if max_cubes < 2:
+        return []
+    k = min(int(cube_vars), max(int(max_cubes), 1).bit_length() - 1)
+    return enumerate_cubes(select_cube_vars(pc, k))

@@ -28,8 +28,11 @@ _FILENAME = "calibration.json"
 
 # stage speed-of-light rates persisted beside per_cell_s (additive keys —
 # same schema version; old entries without them simply report no ceiling
-# for those stages until the next fresh measurement)
-STAGE_RATE_KEYS = ("pack_bytes_s", "ship_bytes_s", "settle_clauses_s")
+# for those stages until the next fresh measurement. ragged_bytes_s was
+# added with the ragged paged dispatch: the router re-measures just the
+# stage rates — no kernel round — when a cached entry predates it)
+STAGE_RATE_KEYS = ("pack_bytes_s", "ship_bytes_s", "ragged_bytes_s",
+                   "settle_clauses_s")
 
 
 def _path() -> str:
@@ -53,7 +56,11 @@ def load_profile(platform: Optional[str], restarts: int,
     """The cached measurement entry for this platform + cell profile —
     {"per_cell_s": float, optional stage rates (STAGE_RATE_KEYS)} — or
     None (measure). A valid per_cell_s gates the whole entry: the cap
-    sizing must never run off a corrupt measurement."""
+    sizing must never run off a corrupt measurement. A 0.0 stage rate
+    is a persisted "measured, unavailable" sentinel — passed through so
+    the router's staleness check sees the attempt (and doesn't re-pay
+    the measurement every process start); ceiling consumers filter
+    > 0 before use."""
     if not platform or not _enabled():
         return None
     try:
@@ -72,7 +79,7 @@ def load_profile(platform: Optional[str], restarts: int,
     out = {"per_cell_s": float(value)}
     for key in STAGE_RATE_KEYS:
         rate = entry.get(key)
-        if isinstance(rate, (int, float)) and rate > 0:
+        if isinstance(rate, (int, float)) and rate >= 0:
             out[key] = float(rate)
     return out
 
@@ -105,7 +112,9 @@ def save_profile(platform: Optional[str], restarts: int, steps: int,
                 pass
             payload["entries"][_key(platform, restarts, steps)] = {
                 **{key: value for key, value in profile.items()
-                   if isinstance(value, (int, float)) and value > 0},
+                   if isinstance(value, (int, float))
+                   and (value > 0 or (value == 0
+                                      and key in STAGE_RATE_KEYS))},
                 "measured_at": int(time.time()),
             }
             from mythril_tpu.service.store import atomic_write_json

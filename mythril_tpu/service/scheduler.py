@@ -41,6 +41,12 @@ log = logging.getLogger(__name__)
 
 DEFAULT_COALESCE_MS = 6.0
 DEFAULT_COALESCE_MAX = 16
+# with ragged paged dispatch live, ONE kernel launch covers a whole
+# window regardless of shape (tpu/circuit.RaggedStream), so a wider
+# default window buys amortization instead of padding waste — the
+# bucketed path keeps the narrow default because its cost scales with
+# the padded slot count, not the window's summed gates
+DEFAULT_COALESCE_MAX_RAGGED = 64
 
 
 from mythril_tpu.support.env import env_float as _env_float
@@ -76,9 +82,21 @@ class CoalescingScheduler:
     def __init__(self):
         self.window_ms = _env_float(
             "MYTHRIL_TPU_COALESCE_MS", DEFAULT_COALESCE_MS)
+        default_max = DEFAULT_COALESCE_MAX
+        try:
+            from mythril_tpu.support.args import args
+            from mythril_tpu.tpu.router import ragged_enabled
+
+            # widen only when ragged dispatch can actually engage: on
+            # the host-only CDCL backend one launch never covers the
+            # window, so the wider buffer would just add flush latency
+            if (ragged_enabled()
+                    and getattr(args, "solver_backend", None) == "tpu"):
+                default_max = DEFAULT_COALESCE_MAX_RAGGED
+        except Exception:  # router import must never break the scheduler
+            pass
         self.max_batch = max(
-            1, int(_env_float("MYTHRIL_TPU_COALESCE_MAX",
-                              DEFAULT_COALESCE_MAX)))
+            1, int(_env_float("MYTHRIL_TPU_COALESCE_MAX", default_max)))
         self._buffer: List[tuple] = []  # (handle, constraint list, crosscheck)
         self._oldest: Optional[float] = None
 

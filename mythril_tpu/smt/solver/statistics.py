@@ -64,6 +64,18 @@ class SolverStatistics:
         "aig_trivial_unsat",
         "aig_components",
         "aig_device_components",
+        # ragged paged device dispatch (tpu/router.py + tpu/circuit.py
+        # RaggedStream): whole coalescing windows packed into flat gate
+        # streams with per-cone offset tables, the cones they carried,
+        # the assembled stream bytes (the ragged roofline stage's work
+        # unit), and the cube-and-conquer second pass — cubes shipped as
+        # assumption-pinned replicas and cubes that came back modelless
+        # (candidate refutations; only the host CDCL can confirm UNSAT)
+        "ragged_windows",
+        "ragged_cones_packed",
+        "paged_stream_bytes",
+        "cubes_dispatched",
+        "cube_device_refutes",
         # incremental cross-query preparation (smt/solver/incremental.py):
         # word-level work reused from sibling queries' prepares — memoized
         # simplify hits, prefix-snapshot resumes (suffix-only pipelines),
@@ -347,6 +359,26 @@ class SolverStatistics:
         instance, whether or not the router later dispatches them)."""
         if self.enabled:
             self.aig_components += components
+
+    def add_ragged_window(self, cones: int, stream_bytes: int) -> None:
+        """One ragged flat stream dispatched (a single kernel launch
+        covering `cones` variable-shape cones), with the assembled
+        paged-stream bytes it shipped. A coalescing window that chunks
+        under the byte/round budgets counts once per stream — the unit
+        is the launch, which is what the evidence cap bounds."""
+        if self.enabled:
+            self.ragged_windows += 1
+            self.ragged_cones_packed += cones
+            self.paged_stream_bytes += stream_bytes
+
+    def add_cube_dispatch(self, cubes: int, refuted: int = 0) -> None:
+        """One cube-and-conquer pass: `cubes` assumption-pinned replicas
+        of a hard cone rode a ragged stream; `refuted` of them came back
+        modelless (candidate refutations — the host CDCL remains the
+        sole UNSAT oracle)."""
+        if self.enabled:
+            self.cubes_dispatched += cubes
+            self.cube_device_refutes += refuted
 
     def add_aig_device_components(self, components: int) -> None:
         """Partitioned sub-cones that rode a device dispatch individually
@@ -649,6 +681,12 @@ class SolverStatistics:
                     f" {self.aig_trivial_unsat} trivially unsat,"
                     f" {self.aig_components} components"
                     f"/{self.aig_device_components} on device)")
+        if self.ragged_windows:
+            out += (f", ragged: {self.ragged_windows} windows"
+                    f" ({self.ragged_cones_packed} cones,"
+                    f" {self.paged_stream_bytes} stream bytes,"
+                    f" {self.cubes_dispatched} cubes"
+                    f"/{self.cube_device_refutes} device refutes)")
         if self.resilience_events:
             out += (f", resilience: {self.resilience_retries} retries"
                     f"/{self.resilience_breaker_trips} breaker trips"

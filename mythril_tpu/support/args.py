@@ -38,6 +38,8 @@ class _Args:
         #   (MYTHRIL_TPU_INCR_PREP=0/1 overrides; smt.solver.incremental)
         self.no_vmap_frontier = False          # --no-vmap-frontier
         #   (MYTHRIL_TPU_VMAP_FRONTIER=0/1 overrides; laser.frontier)
+        self.no_ragged = False                 # --no-ragged
+        #   (MYTHRIL_TPU_RAGGED=0/1 overrides; tpu.router.ragged_enabled)
         self.beam_width = 8                    # --beam-search WIDTH
         self.transaction_sequences = None      # e.g. "[[0xa9059cbb],[-1]]"
         self.jobs = 1                          # corpus-parallel workers (-j)

@@ -36,9 +36,11 @@ def shape_bucket(n: int) -> int:
     128, 192, 256, ... Shape buckets amortize jit compiles; the 1.5x
     intermediate steps halve the worst-case padding waste — production
     256-bit cones land at ~538 levels, and a pow2 bucket would pad (and
-    pay for) 1024. Shared by the batch kernel's padding and the router's
-    level-bucket grouping (tpu/router.py) so one bucket group pads to
-    exactly one device shape."""
+    pay for) 1024. Shared by the batch kernel's padding, the router's
+    level-bucket grouping (tpu/router.py), and the ragged stream's
+    width/root padding (circuit.RaggedStream), so one bucket group pads
+    to exactly one device shape and repeated window shapes reuse one
+    compiled kernel."""
     size = 64
     while size < n:
         if size + size // 2 >= n:
@@ -172,6 +174,19 @@ class DeviceSolverBackend:
         self.pack_bytes = 0
         self.ship_bytes = 0
         self.cells_stepped = 0
+        # ragged flat-stream dispatch (circuit.RaggedStream): streams
+        # dispatched (a chunked window counts one per stream), cones
+        # they carried, assembled stream bytes (the ragged
+        # stage's roofline work unit), wall spent assembling + uploading
+        # streams, and the cube-and-conquer second pass (cubes shipped,
+        # cubes that came back modelless — candidate refutations the
+        # host CDCL alone may confirm)
+        self.ragged_windows = 0
+        self.ragged_cones = 0
+        self.paged_stream_bytes = 0
+        self.ragged_seconds = 0.0
+        self.cubes_dispatched = 0
+        self.cube_device_refutes = 0
         self._jax = None
         self._seed = 0
         self._pack_cache = _LRU(512)        # struct key -> PackedCircuit
@@ -580,6 +595,225 @@ class DeviceSolverBackend:
         self.solve_seconds += now - solve_start
         return results
 
+    # -- ragged flat-stream dispatch (circuit.RaggedStream) ------------------
+
+    def try_solve_batch_ragged(
+        self,
+        problems: Sequence[Tuple[int, Sequence, Tuple]],
+        budget_seconds: float = 4.0,
+        num_restarts: Optional[int] = None,
+        steps: Optional[int] = None,
+        packed_hint: Optional[Sequence] = None,
+        cube_vars: int = 0,
+        cube_min_levels: int = 64,
+        stream_budget: Optional[int] = None,
+    ) -> List[Optional[List[bool]]]:
+        """Solve a WINDOW of blasted queries as ONE ragged flat stream:
+        the cones concatenate into a combined circuit with per-cone paged
+        gate/root tables (circuit.RaggedStream), so a single kernel
+        launch covers the whole window regardless of per-cone shape —
+        no bucket-ceiling padding, no pow2 query slots, no per-bucket
+        dispatch fan-out. Returns per-query model bits or None (the
+        caller's CDCL settles misses and alone proves UNSAT), exactly
+        like try_solve_batch_circuit.
+
+        Cones the plain rounds miss get a cube-and-conquer second pass
+        when `cube_vars` > 0: the cone is replicated onto a fresh ragged
+        stream with 2^k high-centrality input variables pinned per
+        replica (preanalysis/cubes.py), so hundreds of sub-searches ride
+        one launch. A model of any cube is a model of the cone (cube
+        literals are EXTRA asserted roots); modelless cubes are counted
+        as candidate refutations (cube_device_refutes) and the cone
+        stays a miss — the host CDCL is the per-cube fallback and the
+        sole UNSAT oracle."""
+        from mythril_tpu.tpu import circuit
+
+        results: List[Optional[List[bool]]] = [None] * len(problems)
+        try:
+            jax, _ = self._modules()
+        except Exception:
+            return results
+        packed: List[Tuple[int, int, object, object]] = []
+        with trace_span("device.pack", cat="device",
+                        queries=len(problems)):
+            for qi, (num_vars, clauses, aig_roots) in enumerate(problems):
+                if num_vars == 0:
+                    continue
+                if packed_hint is not None and packed_hint[qi] is not None:
+                    pc = packed_hint[qi]
+                else:
+                    pc = self.pack_cone(aig_roots[0], aig_roots[1])
+                if not pc.ok:
+                    continue
+                dense = aig_roots[2] if len(aig_roots) > 2 else None
+                packed.append((qi, num_vars, pc, dense))
+        if not packed:
+            return results
+        call_start = time.monotonic()
+        deadline = call_start + budget_seconds
+        self.batch_calls += 1
+        self.batch_queries += len(packed)
+        if num_restarts is None:
+            num_restarts = self.num_restarts
+        if steps is None:
+            steps = self.CIRCUIT_STEPS
+
+        window_bytes = 0
+        entries = [(pc, ()) for _qi, _nv, pc, _d in packed]
+        solved, nbytes, _ = self._solve_ragged_stream(
+            jax, circuit, entries, deadline, num_restarts, steps)
+        window_bytes += nbytes
+
+        cubes_shipped = cube_refutes = 0
+        if cube_vars > 0 and len(solved) < len(packed):
+            from mythril_tpu.preanalysis import cubes as cube_mod
+            from mythril_tpu.tpu.router import (
+                RAGGED_STREAM_BYTES_DEFAULT,
+                QueryRouter,
+            )
+
+            if stream_budget is None:
+                # direct (router-less) callers get the shared default;
+                # the router passes its resolved budget instead
+                stream_budget = RAGGED_STREAM_BYTES_DEFAULT
+            for i, (_qi, _nv, pc, _dense) in enumerate(packed):
+                if i in solved or pc.num_levels < cube_min_levels:
+                    continue
+                if time.monotonic() >= deadline - 0.05:
+                    break
+                # replica budget: the cube stream re-pages the cone once
+                # per cube, so the combined variable space must stay
+                # inside the kernel compile cap AND the replicated
+                # stream inside the same per-stream memory budget the
+                # plain windows are chunked under
+                max_cubes = (circuit.MAX_VARS - 1) // max(pc.v1 - 1, 1)
+                entry_bytes = QueryRouter.ragged_entry_bytes(pc)
+                max_cubes = min(
+                    max_cubes,
+                    max(stream_budget // max(entry_bytes, 1), 1))
+                plan = cube_mod.plan_cubes(pc, cube_vars, max_cubes)
+                if not plan:
+                    continue
+                cubes_shipped += len(plan)
+                cube_solved, nbytes, cube_done = self._solve_ragged_stream(
+                    jax, circuit, [(pc, cube) for cube in plan],
+                    deadline, num_restarts, steps, stop_at_first=True)
+                window_bytes += nbytes
+                if cube_done and not cube_solved:
+                    # only a modelless stream that ran out its stall
+                    # budget counts its cubes as candidate refutations —
+                    # a deadline-cut stream never searched them, and a
+                    # first-model stop means the cone is settled
+                    cube_refutes += len(plan)
+                if cube_solved:
+                    # every cube is the original cone plus pinned input
+                    # literals: any cube's model satisfies the cone
+                    solved[i] = cube_solved[min(cube_solved)]
+        self.ragged_windows += 1
+        self.ragged_cones += len(packed)
+        self.paged_stream_bytes += window_bytes
+        self.cubes_dispatched += cubes_shipped
+        self.cube_device_refutes += cube_refutes
+        from mythril_tpu.smt.solver.statistics import SolverStatistics
+
+        stats = SolverStatistics()
+        stats.add_ragged_window(len(packed), window_bytes)
+        if cubes_shipped:
+            stats.add_cube_dispatch(cubes_shipped, cube_refutes)
+
+        for i, (qi, num_vars, pc, dense) in enumerate(packed):
+            assignment = solved.get(i)
+            if assignment is None:
+                continue
+            bits = self.bits_from_circuit_assignment(
+                pc, dense, num_vars, assignment)
+            if self._honors(bits, problems[qi][1]):
+                results[qi] = bits
+                self.batch_sat += 1
+                self.sat_found += 1
+            else:
+                log.warning("ragged circuit model failed host clause check")
+        self.device_seconds += time.monotonic() - call_start
+        return results
+
+    def _solve_ragged_stream(self, jax, circuit, entries, deadline,
+                             num_restarts: int, steps: int,
+                             stop_at_first: bool = False):
+        """Assemble, upload, and run ONE ragged stream to (near) the
+        deadline. Returns ({entry index: local cone assignment}, stream
+        bytes, completed) — `completed` is True when the stream ran to
+        all-solved or the stall budget, False when the deadline cut it
+        off (or assembly failed) before the search meant anything.
+        `stop_at_first` exits on the first solved entry (the cube pass:
+        one cube model settles the whole cone, so the remaining
+        replicas are paid-for work with no buyer). Stream assembly +
+        upload accrue into ragged_seconds / paged_stream_bytes (the
+        ragged roofline stage); kernel rounds accrue into
+        solve_seconds / cells_stepped like the batch path."""
+        jnp = jax.numpy
+        ship_start = time.monotonic()
+        stream = circuit.RaggedStream(entries, bucket=shape_bucket)
+        if not stream.ok:
+            self.ragged_seconds += time.monotonic() - ship_start
+            return {}, 0, False
+        tensors = {k: jnp.asarray(v) for k, v in stream.tensors.items()}
+        jax.block_until_ready(list(tensors.values()))
+        self.ragged_seconds += time.monotonic() - ship_start
+        walk_depth = min(stream.num_levels + 4, circuit.MAX_LEVELS)
+        self._seed += 1
+        key = jax.random.PRNGKey(self._seed)
+        key, init_key = jax.random.split(key)
+        x = jax.random.bernoulli(
+            init_key, 0.5, (num_restarts, stream.v1)).astype(jnp.int32)
+        n = stream.num_cones
+        solved = {}
+        rounds = stall = 0
+        solve_start = time.monotonic()
+        with trace_span("device.kernel", cat="device", cones=n,
+                        levels=stream.num_levels, width=stream.width,
+                        restarts=num_restarts) as kernel_span:
+            while True:
+                key, round_key = jax.random.split(key)
+                x, found = circuit.run_round_ragged(
+                    tensors, x, round_key, steps=steps,
+                    walk_depth=walk_depth)
+                rounds += 1
+                # one flip per cone per restart lane per step; sim cost is
+                # the combined circuit once per step (the ragged win)
+                self.flips += n * num_restarts * steps
+                self.cells_stepped += (
+                    steps * 2 * stream.num_levels * stream.width)
+                found_host = np.asarray(found)  # [R, C]
+                newly = [ci for ci in range(n)
+                         if ci not in solved and found_host[:, ci].any()]
+                if newly:
+                    stall = 0
+                    x_host = np.asarray(x)
+                    for ci in newly:
+                        lane = int(np.argmax(found_host[:, ci]))
+                        solved[ci] = stream.cone_assignment(
+                            ci, x_host[lane])
+                else:
+                    stall += 1
+                if (len(solved) == n or stall >= self.STALL_ROUNDS
+                        or (stop_at_first and solved)):
+                    completed = True
+                    break
+                if time.monotonic() >= deadline:
+                    completed = False
+                    break
+                # re-randomize half the lanes for diversification (solved
+                # cones' assignments are already copied to host)
+                key, re_key = jax.random.split(key)
+                half = num_restarts // 2
+                if half:
+                    fresh = jax.random.bernoulli(
+                        re_key, 0.5, (half, stream.v1)).astype(jnp.int32)
+                    x = x.at[:half].set(fresh)
+            kernel_span.set(rounds=rounds)
+        self.solve_seconds += time.monotonic() - solve_start
+        return solved, stream.nbytes, completed
+
     @staticmethod
     def bits_from_circuit_assignment(pc, dense, num_vars, assignment):
         """Translate a cone-local circuit assignment into CNF model bits.
@@ -629,6 +863,12 @@ class DeviceSolverBackend:
             "pack_bytes": self.pack_bytes,
             "ship_bytes": self.ship_bytes,
             "cells_stepped": self.cells_stepped,
+            "ragged_windows": self.ragged_windows,
+            "ragged_cones": self.ragged_cones,
+            "paged_stream_bytes": self.paged_stream_bytes,
+            "ragged_seconds": round(self.ragged_seconds, 4),
+            "cubes_dispatched": self.cubes_dispatched,
+            "cube_device_refutes": self.cube_device_refutes,
             "pack_seconds": round(self.pack_seconds, 4),
             "ship_seconds": round(self.ship_seconds, 4),
             "solve_seconds": round(self.solve_seconds, 4),

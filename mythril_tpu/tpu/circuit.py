@@ -53,7 +53,8 @@ class PackedCircuit:
     __slots__ = ("var_map", "v1", "num_levels", "max_width",
                  "out_idx", "a_var", "a_neg", "b_var", "b_neg",
                  "ga_var", "ga_neg", "gb_var", "gb_neg", "is_gate",
-                 "root_var", "root_neg", "root_mask", "ok", "num_roots")
+                 "root_var", "root_neg", "root_mask", "ok", "num_roots",
+                 "num_gates", "level_rows")
 
     def __init__(self, aig, roots: List[int]):
         self.ok = False
@@ -145,6 +146,14 @@ class PackedCircuit:
         self.ga_var, self.ga_neg = ga_var, ga_neg
         self.gb_var, self.gb_neg = gb_var, gb_neg
         self.is_gate = is_gate
+        # real gate counts (no level padding): per-level row occupancy and
+        # its total. level_rows drives the router's ragged cost model —
+        # a ragged stream's simulated rectangle is
+        # levels x max(summed per-level rows), so chunk planning needs
+        # the level histogram, not just the total
+        self.level_rows = (self.out_idx > 0).sum(axis=1).astype(np.int64) \
+            if self.num_levels else np.zeros((0,), dtype=np.int64)
+        self.num_gates = int(is_gate.sum())
 
         self.num_roots = max(len(live_roots), 1)
         root_var = np.zeros((self.num_roots,), dtype=np.int32)
@@ -204,6 +213,169 @@ class PackedCircuit:
 TENSOR_KEYS = ("out_idx", "a_var", "a_neg", "b_var", "b_neg",
                "ga_var", "ga_neg", "gb_var", "gb_neg", "is_gate",
                "root_var", "root_neg", "root_mask")
+
+# tensors of a ragged flat stream (RaggedStream.tensors): the same table
+# names as the batch path — the combined level/gate tables plus PER-CONE
+# paged root tables ([C, R_max])
+RAGGED_TENSOR_KEYS = TENSOR_KEYS
+
+
+class RaggedStream:
+    """Flat packed gate stream over a WINDOW of variable-shape cones,
+    with per-cone offset tables (paged gate tables).
+
+    The level-bucketed batch path pads every cone in a dispatch to the
+    bucket ceiling and every query slot to a pow2 count, so one deep cone
+    makes every sibling pay for its shape and the long tail is
+    cap-rejected outright. Here the window's cones concatenate instead:
+
+      variables  each cone's local var space (minus the shared constant
+                 0) maps onto a contiguous page [base, base + v1 - 1) of
+                 ONE combined space — cones are variable-disjoint by
+                 construction, so the pages never alias;
+      gates      level l of the combined circuit is the concatenation of
+                 every cone's REAL level-l gates (the per-level padding
+                 of the source PackedCircuits is stripped via the
+                 out_idx > 0 mask), so the simulated cell count is the
+                 sum of gate counts, not levels x max_width x cones;
+      roots      per-cone paged root tables [C, R_max] (root literals
+                 remapped into the page) — `extra_roots` appends cube
+                 assumption literals as additional asserted roots, so
+                 cube-and-conquer replicas ride the same stream.
+
+    One run_round_ragged launch then covers the whole window regardless
+    of per-cone shape: per step it simulates the combined circuit once
+    and walks/flips ONE input per cone (per restart lane), which is
+    exactly the per-cone dispatch semantics minus the padding."""
+
+    __slots__ = ("ok", "num_cones", "cone_slots", "v1", "num_levels",
+                 "width", "max_roots", "pages", "tensors")
+
+    def __init__(self, entries, bucket=None):
+        """`entries`: sequence of (PackedCircuit, extra_roots) where
+        extra_roots is a sequence of (local_var, want_bool) cube
+        assumptions (empty for plain cones). Every pc must be `ok`.
+
+        EVERY tensor dimension (levels, row width, combined vars, cone
+        slots, roots) pads to a shape bucket: window composition varies
+        call to call, and without bucketing each window shape would pay
+        its own jit compile. Padding cone slots carry an all-zero root
+        mask (satisfied at step 0, walks park on var 0); padding vars
+        and levels are inert var-0 plumbing, exactly like the batch
+        kernel's padding."""
+        if bucket is None:
+            # lazy: backend.py stays importable without jax, so the
+            # shared bucket function cannot be a module-level default here
+            from mythril_tpu.tpu.backend import shape_bucket as bucket
+        self.ok = False
+        self.num_cones = len(entries)
+        if not entries:
+            return
+        pages = []
+        cursor = 1
+        num_levels = 0
+        max_roots = 1
+        for pc, extra in entries:
+            if not pc.ok:
+                return
+            pages.append((cursor, pc.v1 - 1))
+            cursor += pc.v1 - 1
+            num_levels = max(num_levels, pc.num_levels)
+            max_roots = max(max_roots, pc.num_roots + len(extra))
+        self.pages = pages
+        self.v1 = bucket(cursor)
+        self.num_levels = bucket(max(num_levels, 1))
+        self.max_roots = bucket(max_roots)
+        # pow2 cone-slot ramp (cone counts are small; 1.5x buckets under
+        # 64 would all collapse to 64 and waste root-table rows)
+        slots = 1
+        while slots < self.num_cones:
+            slots *= 2
+        self.cone_slots = slots
+
+        # combined per-level rows: real gates only (out_idx > 0 strips the
+        # source circuits' per-level padding), remapped into the page
+        def remap(arr, base):
+            return np.where(arr > 0, arr + (base - 1), 0).astype(np.int32)
+
+        level_keys = ("out_idx", "a_var", "a_neg", "b_var", "b_neg")
+        # per-cone scatter plan: each cone's live (real-gate) cells land
+        # at its running per-level offset in one fancy-index assignment
+        # per (cone, key) — assembly wall accrues into ragged_seconds,
+        # which the router charges against admission/chunk budgets, so
+        # an O(cones x levels) python loop here would directly shrink
+        # what gets admitted to the device
+        offsets = np.zeros((num_levels,), dtype=np.int64)
+        placements = []  # (pc, base, live mask, level idx, column idx)
+        for (pc, _extra), (base, _size) in zip(entries, pages):
+            live = pc.out_idx > 0
+            if not live.any():
+                continue
+            lv_idx = np.nonzero(live)[0]
+            rank = (live.cumsum(axis=1) - 1)[live]
+            placements.append((pc, base, live, lv_idx,
+                               offsets[lv_idx] + rank))
+            offsets[: pc.num_levels] += live.sum(axis=1)
+        self.width = bucket(max(int(offsets.max()) if num_levels else 1, 1))
+
+        tensors = {}
+        for key in level_keys:
+            out = np.zeros((self.num_levels, self.width), dtype=np.int32)
+            for pc, base, live, lv_idx, col_idx in placements:
+                src = getattr(pc, key)[live]
+                if key in ("out_idx", "a_var", "b_var"):
+                    src = remap(src, base)
+                out[lv_idx, col_idx] = src
+            tensors[key] = out
+
+        # combined per-var gate tables (page-sliced copies)
+        for key in ("ga_var", "ga_neg", "gb_var", "gb_neg", "is_gate"):
+            out = np.zeros((self.v1,), dtype=np.int32)
+            for (pc, _extra), (base, size) in zip(entries, pages):
+                src = getattr(pc, key)[1:]
+                if key in ("ga_var", "gb_var"):
+                    src = remap(src, base)
+                out[base: base + size] = src
+            tensors[key] = out
+
+        # per-cone paged root tables (cone roots + cube assumption roots;
+        # padding cone slots keep an all-zero mask)
+        root_var = np.zeros((self.cone_slots, self.max_roots),
+                            dtype=np.int32)
+        root_neg = np.zeros_like(root_var)
+        root_mask = np.zeros_like(root_var)
+        for ci, ((pc, extra), (base, _size)) in enumerate(
+                zip(entries, pages)):
+            n = pc.num_roots
+            root_var[ci, :n] = remap(pc.root_var, base)
+            root_neg[ci, :n] = pc.root_neg
+            root_mask[ci, :n] = pc.root_mask
+            for j, (lvar, want) in enumerate(extra):
+                root_var[ci, n + j] = lvar + base - 1 if lvar > 0 else 0
+                root_neg[ci, n + j] = 0 if want else 1
+                root_mask[ci, n + j] = 1
+        tensors["root_var"] = root_var
+        tensors["root_neg"] = root_neg
+        tensors["root_mask"] = root_mask
+        self.tensors = tensors
+        self.ok = True
+
+    @property
+    def nbytes(self) -> int:
+        """Assembled stream bytes — the ragged pack/ship work unit
+        (paged_stream_bytes, and the ragged roofline stage)."""
+        if not self.ok:
+            return 0
+        return int(sum(self.tensors[k].nbytes for k in RAGGED_TENSOR_KEYS))
+
+    def cone_assignment(self, ci: int, x_row: np.ndarray) -> np.ndarray:
+        """Slice one cone's local assignment out of a combined restart
+        row: local var v (v >= 1) lives at combined index base + v - 1;
+        local var 0 is the shared constant FALSE."""
+        base, size = self.pages[ci]
+        out = np.zeros((size + 1,), dtype=x_row.dtype)
+        out[1:] = x_row[base: base + size]
+        return out
 
 
 def _simulate(x, levels):
@@ -323,6 +495,115 @@ def run_round_circuit_batch(tensors: dict, x, keys, steps: int,
         lambda t, xx, kk: run_round_circuit(
             t, xx, kk, steps=steps, walk_depth=walk_depth)
     )(tensors, x, keys)
+
+
+def _walk_ragged(x, start_var, start_neg, key, tables, depth):
+    """Per-cone backward justification walk over a ragged flat stream:
+    `start_var`/`start_neg` are [R, C] (one walk per cone per restart
+    lane), gathers read the shared combined assignment x [R, V1].
+    Returns ([R, C] var_to_flip, [R, C] wanted_value). Cones are
+    variable-disjoint pages of the combined space, so the C walks can
+    never interfere; a cone parked on var 0 (already satisfied this
+    step) terminates immediately (is_gate[0] == 0)."""
+    ga_var, ga_neg, gb_var, gb_neg, is_gate = tables
+
+    def body(carry, step_key):
+        cur, want, done = carry
+        is_g = (is_gate[cur] == 1) & (~done)
+        av_i, an = ga_var[cur], ga_neg[cur]
+        bv_i, bn = gb_var[cur], gb_neg[cur]
+        av = jnp.take_along_axis(x, av_i, axis=1) ^ an
+        bv = jnp.take_along_axis(x, bv_i, axis=1) ^ bn
+        gate_val = av & bv
+        justified = gate_val == want
+        coin = jax.random.bernoulli(step_key, 0.5, cur.shape)
+        choose_b1 = ((av == 1) & (bv == 0)) | ((av == 0) & (bv == 0) & coin)
+        choose_b0 = ((av == 0) & (bv == 1)) | ((av == 1) & (bv == 1) & coin)
+        choose_b = jnp.where(want == 1, choose_b1, choose_b0)
+        child_var = jnp.where(choose_b, bv_i, av_i)
+        child_neg = jnp.where(choose_b, bn, an)
+        child_want = want ^ child_neg
+        step_active = is_g & (~justified)
+        cur = jnp.where(step_active, child_var, cur)
+        want = jnp.where(step_active, child_want, want)
+        done = done | (~is_g) | justified
+        return (cur, want, done), None
+
+    keys = jax.random.split(key, depth)
+    want0 = jnp.ones_like(start_var) ^ start_neg
+    done0 = start_var < 0
+    (cur, want, _), _ = lax.scan(body, (start_var, want0, done0), keys)
+    return cur, want
+
+
+@functools.partial(jax.jit, static_argnames=("steps", "walk_depth"))
+def run_round_ragged(tensors: dict, x, key, steps: int, walk_depth: int):
+    """Advance R restart lanes of ONE ragged flat stream by `steps`
+    sim+flip iterations. tensors: dict of RAGGED_TENSOR_KEYS arrays
+    (per-cone paged root tables root_var/root_neg/root_mask are
+    [C, R_max]); x is [R, V1]. Returns (x, found) with found [R, C] —
+    per-lane, PER-CONE satisfaction, so each cone settles independently
+    (different cones may solve in different restart lanes: their pages
+    are variable-disjoint, and extraction slices per cone).
+
+    Each step simulates the combined circuit once and flips one input
+    per cone per lane (the per-cone justification walk), which preserves
+    the single-cone kernel's flips-per-cone rate while the simulation
+    cost is the window's summed gate count — the whole point of the
+    ragged pack."""
+    levels = (tensors["out_idx"], tensors["a_var"], tensors["a_neg"],
+              tensors["b_var"], tensors["b_neg"])
+    tables = (tensors["ga_var"], tensors["ga_neg"],
+              tensors["gb_var"], tensors["gb_neg"], tensors["is_gate"])
+    root_var = tensors["root_var"]    # [C, R_max]
+    root_neg = tensors["root_neg"]
+    root_mask = tensors["root_mask"]
+    R = x.shape[0]
+    C = root_var.shape[0]
+    rows = jnp.arange(R)
+
+    def step(carry, step_key):
+        x, found = carry
+        x = x.at[:, 0].set(0)
+        x = _simulate(x, levels)
+        root_vals = jnp.take(
+            x, root_var.reshape(-1), axis=1
+        ).reshape(R, C, -1) ^ root_neg[None, :, :]
+        violated = (root_vals == 0) & (root_mask[None, :, :] == 1)
+        found = found | (violated.sum(axis=2) == 0)
+        k_root, k_walk = jax.random.split(step_key)
+        logits = jnp.where(violated, 0.0, -1e9)
+        choice = jax.random.categorical(k_root, logits, axis=2)  # [R, C]
+        start_var = jnp.take_along_axis(
+            jnp.broadcast_to(root_var[None, :, :], logits.shape),
+            choice[..., None], axis=2)[..., 0]
+        start_neg = jnp.take_along_axis(
+            jnp.broadcast_to(root_neg[None, :, :], logits.shape),
+            choice[..., None], axis=2)[..., 0]
+        # satisfied cones park their walk on var 0 (done at entry); the
+        # flip then rewrites x[:, 0], which every step resets to 0
+        start_var = jnp.where(found, 0, start_var)
+        flip_var, flip_want = _walk_ragged(
+            x, start_var, start_neg, k_walk, tables, walk_depth)
+        cur_val = jnp.take_along_axis(x, flip_var, axis=1)
+        new_val = jnp.where(found, cur_val, flip_want)
+        x = x.at[rows[:, None], flip_var].set(new_val)
+        return (x, found), None
+
+    # derive from x (not a fresh constant): varying manual axes must
+    # match the carry output under shard_map (scan-vma)
+    found0 = jnp.broadcast_to((jnp.sum(x, axis=1) < -1)[:, None], (R, C))
+    keys = jax.random.split(key, steps)
+    (x, found), _ = lax.scan(step, (x, found0), keys)
+    # final simulate: returned assignments must be gate-consistent
+    x = x.at[:, 0].set(0)
+    x = _simulate(x, levels)
+    root_vals = jnp.take(
+        x, root_var.reshape(-1), axis=1
+    ).reshape(R, C, -1) ^ root_neg[None, :, :]
+    violated = (root_vals == 0) & (root_mask[None, :, :] == 1)
+    found = found | (violated.sum(axis=2) == 0)
+    return x, found
 
 
 def init_inputs(key, num_restarts: int, v1: int):

@@ -27,6 +27,26 @@ This module replaces the hard-coded constants with a measured routing layer
                 one deep cone cannot force every sibling to pad — and pay —
                 for its shape; per-bucket dispatches reuse the jit cache
                 across calls because bucketed shapes repeat.
+  ragged      — the DEFAULT dispatch mode (MYTHRIL_TPU_RAGGED=0 or
+                --no-ragged restores level buckets): the whole window's
+                variable-shape cones concatenate into ONE flat gate
+                stream with per-cone offset tables
+                (circuit.RaggedStream), so a single kernel launch covers
+                the window regardless of shape. The shape-based
+                admission caps become memory-budget checks — a cone is
+                rejected only when its estimated stream contribution
+                alone busts MYTHRIL_TPU_RAGGED_STREAM_BYTES — and the
+                cost model is bytes/gate-based (est_ragged_round_seconds
+                over summed REAL gate counts, not bucket ceilings).
+                Windows whose summed gates would blow the round budget
+                (or whose bytes blow the stream budget) chunk into
+                several streams. Cones the plain rounds miss get a
+                cube-and-conquer second pass (preanalysis/cubes.py):
+                2^k high-centrality input variables pinned per replica
+                ride a fresh ragged stream; any cube's model is a model
+                of the cone, modelless cubes are candidate refutations
+                only, and the host CDCL stays the per-cube fallback and
+                sole UNSAT oracle.
   deadline    — each get_models_batch dispatch gets a bounded device budget
                 (never more than MYTHRIL_TPU_DEVICE_DEADLINE and never more
                 than 60% of the shared query timeout), so the CDCL settling
@@ -84,6 +104,23 @@ Env summary (all optional):
                                 (default 2 — bounds round wall on the
                                 serialized host core and pins the jit
                                 shape space so the compile cache stays hot)
+  MYTHRIL_TPU_RAGGED            0 disables / 1 force-enables ragged
+                                paged dispatch over the --no-ragged flag
+                                (default: enabled)
+  MYTHRIL_TPU_RAGGED_STREAM_BYTES  memory budget per assembled ragged
+                                stream; windows chunk to fit (default
+                                48 MiB)
+  MYTHRIL_TPU_RAGGED_WINDOW_CAP evidence-mode ragged stream launches
+                                per process on the CPU platform (a
+                                window that chunks consumes one per
+                                stream; default 4; 0 disables ragged
+                                dispatch there)
+  MYTHRIL_TPU_CUBE_VARS         cube-and-conquer split width k (2^k
+                                cubes per hard cone; default 3 on the
+                                CPU platform, 7 on a real device; 0
+                                disables cubing)
+  MYTHRIL_TPU_CUBE_MIN_LEVELS   only cones at least this deep get the
+                                cube second pass (default 64)
 """
 
 import logging
@@ -146,6 +183,10 @@ DEFAULT_CELL_CAP_CPU = 1 << 22
 DEFAULT_CELL_CAP_DEVICE = 1 << 22
 DEFAULT_VAR_CAP_CPU = 1 << 15
 DEFAULT_VAR_CAP_DEVICE = 1 << 16
+# per-stream memory budget of the ragged paged dispatch
+# (MYTHRIL_TPU_RAGGED_STREAM_BYTES overrides) — shared with the
+# backend's cube pass so replica streams respect the same bound
+RAGGED_STREAM_BYTES_DEFAULT = 48 * 1024 * 1024
 
 CAL_STEPS = 8  # micro-calibration round length (tiny on purpose)
 
@@ -158,6 +199,22 @@ def _env_int(name: str) -> Optional[int]:
         return int(os.environ[name])
     except (KeyError, ValueError):
         return None
+
+
+def ragged_enabled() -> bool:
+    """Ragged paged dispatch gate: env override first (MYTHRIL_TPU_RAGGED),
+    then the --no-ragged CLI flag; default ON. Module-level (not a router
+    method) because the coalescing scheduler consults it too — one ragged
+    launch covers a whole window, so the scheduler widens its default
+    window when this path is live."""
+    env = os.environ.get("MYTHRIL_TPU_RAGGED", "")
+    if env in ("0", "off", "false"):
+        return False
+    if env in ("1", "on", "true"):
+        return True
+    from mythril_tpu.support.args import args
+
+    return not getattr(args, "no_ragged", False)
 
 
 class QueryRouter:
@@ -198,6 +255,29 @@ class QueryRouter:
             _env_float("MYTHRIL_TPU_HOST_DIRECT_LEVELS", 24))
         self.cpu_dispatch_cap = int(
             _env_float("MYTHRIL_TPU_CPU_DISPATCH_CAP", 2))
+        # ragged paged dispatch: per-stream memory budget (the admission
+        # check that replaced the shape caps) and the evidence-mode
+        # window cap — ragged windows amortize a WHOLE coalescing window
+        # per launch, so they get their own (much higher) cap instead of
+        # the per-query-bucketed cpu_dispatch_cap
+        self.ragged_stream_budget = int(_env_float(
+            "MYTHRIL_TPU_RAGGED_STREAM_BYTES", RAGGED_STREAM_BYTES_DEFAULT))
+        # default 4: double the bucketed path's evidence budget (the
+        # ragged launch amortizes a whole window), but still bounded —
+        # on the serialized virtual-CPU platform every device round
+        # costs ~2s wall that the 3 ms-per-settle CDCL would not, so an
+        # unbounded ragged path turns the evidence stand-in into a
+        # slowdown. Real devices are not evidence mode and never hit
+        # this cap.
+        self.ragged_window_cap = int(
+            _env_float("MYTHRIL_TPU_RAGGED_WINDOW_CAP", 4))
+        # ragged STREAMS dispatched this process: a coalescing window
+        # that chunks under the byte/round budgets consumes one unit per
+        # stream — each stream is its own serialized launch, and the
+        # launch is the wall the evidence cap exists to bound
+        self.ragged_windows = 0
+        self.cube_min_levels = int(
+            _env_float("MYTHRIL_TPU_CUBE_MIN_LEVELS", 64))
 
     def _platform(self) -> Optional[str]:
         try:
@@ -296,20 +376,35 @@ class QueryRouter:
                 if isinstance(cached.get(key), (int, float))
                 and cached[key] > 0
             }
-            if not self._stage_rates:
-                # pre-roofline cache entry: per_cell_s without stage
-                # ceilings. The valid per_cell_s would otherwise skip
-                # measurement FOREVER (entries have no TTL) and every
-                # pack/ship/settle roofline row would report no ceiling
-                # on this install for good — measure just the stage
-                # rates (no kernel round, no compile) and re-save.
+            if any(key not in cached for key in STAGE_RATE_KEYS):
+                # pre-roofline (or pre-ragged) cache entry: per_cell_s
+                # without the full stage-ceiling set. The valid
+                # per_cell_s would otherwise skip measurement FOREVER
+                # (entries have no TTL) and the missing stages would
+                # report no ceiling on this install for good — measure
+                # just the stage rates (no kernel round, no compile)
+                # and re-save, with a 0.0 sentinel for any stage whose
+                # best-effort measurement produced nothing (key present
+                # = attempted, so a deterministically failing stage
+                # can't re-trigger this startup measurement every run;
+                # the > 0 filters keep sentinels out of the ceilings).
+                # Sentinels are written ONLY alongside at least one
+                # successful rate: a wholesale measurement failure is
+                # far more likely transient (load, native-solver hiccup)
+                # than deterministic, and all-sentinel persistence would
+                # turn that one transient into no-ceilings-forever.
                 try:
                     rates = self._measure_stage_rates_fresh()
-                    self._stage_rates = rates
-                    if rates:
-                        save_profile(platform, restarts, steps,
-                                     {"per_cell_s": self._per_cell_s,
-                                      **rates})
+                    # cached valid ceilings survive a transiently
+                    # failed re-measure; fresh values win where both
+                    # exist (they're newer)
+                    self._stage_rates = {**self._stage_rates, **rates}
+                    save_profile(platform, restarts, steps,
+                                 {"per_cell_s": self._per_cell_s,
+                                  **({key: 0.0
+                                      for key in STAGE_RATE_KEYS}
+                                     if self._stage_rates else {}),
+                                  **self._stage_rates})
                 except Exception as error:
                     log.info("stage-rate calibration failed (%s); "
                              "roofline ceilings unavailable", error)
@@ -326,6 +421,8 @@ class QueryRouter:
                      time.monotonic() - start)
             save_profile(platform, restarts, steps,
                          {"per_cell_s": self._per_cell_s,
+                          **({key: 0.0 for key in STAGE_RATE_KEYS}
+                             if self._stage_rates else {}),
                           **self._stage_rates})
             return True
         except Exception as error:
@@ -423,14 +520,14 @@ class QueryRouter:
         cells = pc.num_levels * max(pc.max_width, 1)
         return max(elapsed / (CAL_STEPS * 2 * cells), 1e-12)
 
-    @staticmethod
-    def _measure_stage_rates(pc, padded, pack_elapsed: float,
+    def _measure_stage_rates(self, pc, padded, pack_elapsed: float,
                              ship_elapsed: float, prep) -> dict:
         """Speed-of-light rates for the non-kernel stages, measured on the
         calibration circuit: pack (levelization) bytes/s, ship (upload)
-        bytes/s, settle (host CDCL) clauses/s. The settle loop calls the
-        raw solver entry points so calibration never pollutes the
-        cdcl_settles / settle_wall telemetry it exists to contextualize."""
+        bytes/s, ragged (flat-stream assembly + upload) bytes/s, settle
+        (host CDCL) clauses/s. The settle loop calls the raw solver
+        entry points so calibration never pollutes the cdcl_settles /
+        settle_wall telemetry it exists to contextualize."""
         import numpy as np
 
         from mythril_tpu.smt.solver import sat_backend
@@ -443,6 +540,26 @@ class QueryRouter:
                                 for v in padded.values()))
         if ship_elapsed > 0 and shipped_bytes:
             rates["ship_bytes_s"] = shipped_bytes / ship_elapsed
+        # ragged pack/ship ceiling: assemble + upload a small two-cone
+        # flat stream from the same calibration circuit (two entries of
+        # one cone page onto disjoint variable ranges, exactly like a
+        # production window). Best-effort like every stage rate here.
+        try:
+            jax, _ = self.backend._modules()
+            from mythril_tpu.tpu import circuit
+
+            ragged_start = time.monotonic()
+            stream = circuit.RaggedStream([(pc, ()), (pc, ())])
+            if stream.ok:
+                tensors = {k: jax.numpy.asarray(v)
+                           for k, v in stream.tensors.items()}
+                jax.block_until_ready(list(tensors.values()))
+                ragged_elapsed = time.monotonic() - ragged_start
+                if ragged_elapsed > 0 and stream.nbytes:
+                    rates["ragged_bytes_s"] = stream.nbytes / ragged_elapsed
+        except Exception as error:
+            log.info("ragged stage-rate calibration failed (%s); ragged "
+                     "roofline ceiling unavailable", error)
         lib = sat_backend._get_native()
         num_clauses = len(prep.clauses)
         if num_clauses:
@@ -526,6 +643,81 @@ class QueryRouter:
         return (getattr(backend, "pack_seconds", 0.0)
                 + getattr(backend, "ship_seconds", 0.0)) / total
 
+    # -- ragged cost model (stream rectangle, not bucket shapes) -------------
+
+    @staticmethod
+    def _max_level_row(pc) -> int:
+        """Widest REAL level row of a packed cone (its padding-stripped
+        contribution to a ragged stream's combined width). Falls back to
+        a uniform gates-over-levels spread when the cone carries no
+        per-level histogram (scripted test fakes)."""
+        rows = getattr(pc, "level_rows", None)
+        if rows is not None and len(rows):
+            return int(rows.max())
+        gates = getattr(pc, "num_gates", pc.num_levels * pc.max_width)
+        return max(-(-gates // max(pc.num_levels, 1)), 1)
+
+    def ragged_round_cells(self, pc) -> int:
+        """Simulated rectangle of a single-cone ragged stream: the
+        kernel walks bucket(levels) x bucket(width) per step, where width
+        is the cone's widest REAL level row — per-level padding is
+        stripped at pack time, but the combined tensor is still
+        rectangular, so the honest work unit is this rectangle, NOT the
+        raw gate sum (charging the gate sum under-estimated deep sparse
+        windows ~40x and every window blew the dispatch deadline)."""
+        return (shape_bucket(max(pc.num_levels, 1))
+                * shape_bucket(self._max_level_row(pc)))
+
+    def est_ragged_round_seconds(self, cells: int) -> float:
+        """Cost-model estimate of ONE ragged kernel round over a stream
+        whose combined rectangle is `cells` (levels x width, both
+        bucketed). Same measured per-cell constant and sim+walk 2x as
+        est_round_seconds; the difference is the work unit: the
+        rectangle the stream actually ships, never a per-query bucket
+        ceiling replicated across the window."""
+        per_cell = self._per_cell_s
+        if per_cell is None:
+            per_cell = 1e-7 if self._evidence_mode() else 1e-9
+        return per_cell * self._profile_steps() * 2 * max(cells, 1)
+
+    def ragged_chunk_budget_s(self) -> float:
+        """Round-time budget ONE ragged chunk may cost: a chunk's round
+        must complete inside the dispatch deadline (the hard
+        deadline-runner bound), not just the calibration round budget —
+        a chunk admitted at round_budget but over the deadline would be
+        abandoned mid-round by the runner and trip the breaker HARD.
+        The 0.8 margin leaves room for the walk pass and upload."""
+        return 0.8 * min(self.round_budget_s, self.dispatch_deadline())
+
+    def ragged_prep_overhead_seconds(self) -> float:
+        """Amortized stream assembly + upload wall per ragged window —
+        the ragged counterpart of prep_overhead_seconds (observed mean
+        over the backend's dispatched windows; 0 until the first one)."""
+        backend = self.backend
+        windows = getattr(backend, "ragged_windows", 0)
+        if not windows:
+            return 0.0
+        return getattr(backend, "ragged_seconds", 0.0) / windows
+
+    @staticmethod
+    def ragged_entry_bytes(pc) -> int:
+        """Estimated contribution of one cone to an assembled ragged
+        stream: the level-row payload (5 int32 arrays over the cone's
+        levels x widest-real-row rectangle) plus the per-var tables,
+        with 2x slack for combined-row bucketing. An estimate on
+        purpose — the exact combined shape depends on the whole window's
+        per-level histograms, and the budget check only needs the right
+        order."""
+        rect = pc.num_levels * QueryRouter._max_level_row(pc)
+        return (rect * 5 + pc.v1 * 5) * 4 * 2
+
+    def cube_vars(self) -> int:
+        """Cube-and-conquer split width k (2^k cubes per hard cone):
+        small in evidence mode (the replicas serialize on the host
+        core), wide on a real device — the "hundreds of cubes" regime."""
+        return int(_env_float("MYTHRIL_TPU_CUBE_VARS",
+                              3 if self._evidence_mode() else 7))
+
     # -- health breaker (resilience/breaker.py) -----------------------------
 
     @property
@@ -559,12 +751,19 @@ class QueryRouter:
         return self._breaker.allow()
 
     def record_dispatch(self, hits: int, seconds: float,
-                        errored: bool = False) -> None:
+                        errored: bool = False,
+                        ragged: bool = False) -> None:
         """Feed the breaker: device wall with zero models found charges
         the waste budget (a legitimate miss, never the error count); a
         dispatch EXCEPTION charges the error count; one hit forgives
-        everything."""
-        self.dispatches += 1
+        everything. Ragged streams count against their own evidence cap
+        (ragged_window_cap), never the bucketed dispatch cap — one
+        stream launch amortizes a whole coalescing window (or one chunk
+        of a window the byte/round budgets split)."""
+        if ragged:
+            self.ragged_windows += 1
+        else:
+            self.dispatches += 1
         if not self._breaker.waste_budget_s:
             self._breaker.waste_budget_s = self._waste_budget()
         if hits > 0:
@@ -625,6 +824,28 @@ class QueryRouter:
         return run_with_deadline(
             "device.dispatch", _call, remaining + self._deadline_grace())
 
+    def _guarded_ragged_dispatch(self, group, remaining, profile):
+        """One ragged stream dispatch under the SAME device.dispatch
+        fault seam as the bucketed path (injection site, deadline runner,
+        breaker feed): the cube-and-conquer second pass runs inside the
+        backend call, so one guard covers plain rounds and cube settle
+        alike."""
+
+        def _call():
+            maybe_inject("device.dispatch")
+            return self.backend.try_solve_batch_ragged(
+                [unit.problem for unit in group],
+                budget_seconds=remaining,
+                packed_hint=[unit.pc for unit in group],
+                cube_vars=self.cube_vars(),
+                cube_min_levels=self.cube_min_levels,
+                stream_budget=self.ragged_stream_budget,
+                **profile,
+            )
+
+        return run_with_deadline(
+            "device.dispatch", _call, remaining + self._deadline_grace())
+
     # -- batched dispatch (support/model.get_models_batch) ------------------
 
     def dispatch(
@@ -666,7 +887,13 @@ class QueryRouter:
         results: List[Optional[List[bool]]] = [None] * len(problems)
         if not problems or not self.device_usable():
             return results
-        if self._dispatches_remaining() <= 0:
+        use_ragged = ragged_enabled()
+        if use_ragged:
+            if (self._evidence_mode()
+                    and self.ragged_windows >= self.ragged_window_cap):
+                # ragged evidence budget spent: host-only from here on
+                return results
+        elif self._dispatches_remaining() <= 0:
             # evidence budget spent (CPU platform): host-only from here on
             return results
         platform = self._platform()
@@ -710,7 +937,7 @@ class QueryRouter:
             if partition is not None:
                 state = self._plan_components(
                     qi, num_vars, aig_roots, partition, caps, buckets,
-                    stats)
+                    stats, ragged=use_ragged)
                 if state is not None:
                     states[qi] = state
                     continue
@@ -719,7 +946,8 @@ class QueryRouter:
                 continue
             if not pc.ok:
                 continue  # trivially unsat roots: CDCL proves it
-            verdict = self._admission(pc, caps)
+            verdict = (self._admission_ragged(pc) if use_ragged
+                       else self._admission(pc, caps))
             if verdict == "cap":
                 self.backend.count_cap_reject(
                     under_floor=(pc.num_levels <= LEVEL_CAP_FLOOR
@@ -743,11 +971,18 @@ class QueryRouter:
                 _Unit(qi, None, pc, problem))
 
         deadline = time.monotonic() + budget
+        from mythril_tpu.resilience import breaker as breaker_mod
+
+        if use_ragged:
+            self._dispatch_ragged(buckets, states, results, problems,
+                                  deadline, profile, evidence, stats)
+            if states:
+                self._settle_components(states, results, problems,
+                                        timeout_s, stats)
+            return results
         # biggest group first: under the evidence-mode dispatch cap and the
         # shared deadline, the fullest bucket yields the most amortization
         # per dispatch (and the most device models per second spent)
-        from mythril_tpu.resilience import breaker as breaker_mod
-
         for bucket_level in sorted(
                 buckets, key=lambda b: -len(buckets[b])):
             # break once the breaker is OPEN (tripped mid-loop) — but a
@@ -796,33 +1031,151 @@ class QueryRouter:
                         len(group), single_device=evidence),
                     elapsed)
             self.record_dispatch(hits, elapsed)
-            device_components = 0
-            for unit, bits in zip(group, group_bits):
-                if unit.component is None:
-                    results[unit.qi] = bits
-                    continue
-                # a projected sub-cone rode the device path individually
-                device_components += 1
-                unit.resolved = True
-                state = states[unit.qi]
-                if bits is not None:
-                    from mythril_tpu.preanalysis.aig_partition import (
-                        component_vars,
-                        merge_component_bits,
-                    )
-
-                    merge_component_bits(
-                        unit.comp_dense, problems[unit.qi][2][2],
-                        component_vars(unit.comp_dense), bits,
-                        state.merged)
-                else:
-                    state.host.append(unit)
-            if stats is not None and device_components:
-                stats.add_aig_device_components(device_components)
+            self._apply_group_bits(group, group_bits, results, states,
+                                   problems, stats)
         if states:
             self._settle_components(states, results, problems, timeout_s,
                                     stats)
         return results
+
+    @staticmethod
+    def _apply_group_bits(group, group_bits, results, states, problems,
+                          stats) -> None:
+        """Land one dispatch's per-unit model bits: monolithic units
+        write their query slot, projected components merge into their
+        query's split state (misses go to the in-router host list).
+        Shared by the bucketed and ragged dispatch loops."""
+        device_components = 0
+        for unit, bits in zip(group, group_bits):
+            if unit.component is None:
+                results[unit.qi] = bits
+                continue
+            # a projected sub-cone rode the device path individually
+            device_components += 1
+            unit.resolved = True
+            state = states[unit.qi]
+            if bits is not None:
+                from mythril_tpu.preanalysis.aig_partition import (
+                    component_vars,
+                    merge_component_bits,
+                )
+
+                merge_component_bits(
+                    unit.comp_dense, problems[unit.qi][2][2],
+                    component_vars(unit.comp_dense), bits,
+                    state.merged)
+            else:
+                state.host.append(unit)
+        if stats is not None and device_components:
+            stats.add_aig_device_components(device_components)
+
+    def _chunk_ragged(self, window: List[_Unit]) -> List[List[_Unit]]:
+        """Greedy chunking of a window's admitted units into streams: a
+        chunk closes when adding the next cone would bust the stream
+        memory budget, push the combined variable space past the kernel
+        compile cap (MAX_VARS — enforced per cone at pack time, so the
+        concatenated pages must re-check it), or push the estimated
+        combined ROUND past the chunk budget (one round must fit the
+        dispatch deadline). The combined rectangle is tracked honestly —
+        per-level summed real rows, bucketed the way RaggedStream will
+        actually pad — so the estimate matches the cells the kernel
+        walks. A single cone over any bound was already turned away at
+        admission, so every chunk is non-empty."""
+        import numpy as np
+
+        from mythril_tpu.tpu.circuit import MAX_VARS
+
+        budget_s = self.ragged_chunk_budget_s()
+        # the same amortized assembly+upload wall admission charges: a
+        # chunk packed to the raw round estimate alone would leave no
+        # headroom for stream prep inside the dispatch deadline
+        prep_s = self.ragged_prep_overhead_seconds()
+        chunks: List[List[_Unit]] = [[]]
+        chunk_bytes = 0
+        chunk_vars = 0  # combined page space (var 0 shared)
+        chunk_rows = np.zeros((0,), dtype=np.int64)  # combined level rows
+
+        def combined_cells(rows, pc):
+            levels = max(len(rows), pc.num_levels, 1)
+            merged = np.zeros((levels,), dtype=np.int64)
+            merged[: len(rows)] = rows
+            pc_rows = getattr(pc, "level_rows", None)
+            if pc_rows is not None and len(pc_rows):
+                merged[: len(pc_rows)] += pc_rows
+            else:
+                merged[: pc.num_levels] += self._max_level_row(pc)
+            cells = (shape_bucket(levels)
+                     * shape_bucket(int(merged.max()) if levels else 1))
+            return merged, cells
+
+        for unit in window:
+            entry_bytes = self.ragged_entry_bytes(unit.pc)
+            unit_vars = max(unit.pc.v1 - 1, 0)
+            merged, cells = combined_cells(chunk_rows, unit.pc)
+            if chunks[-1] and (
+                    chunk_bytes + entry_bytes > self.ragged_stream_budget
+                    or 1 + chunk_vars + unit_vars > MAX_VARS
+                    or self.est_ragged_round_seconds(cells) + prep_s
+                    > budget_s):
+                chunks.append([])
+                chunk_bytes = 0
+                chunk_vars = 0
+                merged, cells = combined_cells(
+                    np.zeros((0,), dtype=np.int64), unit.pc)
+            chunks[-1].append(unit)
+            chunk_bytes += entry_bytes
+            chunk_vars += unit_vars
+            chunk_rows = merged
+        return chunks if chunks[-1] else chunks[:-1]
+
+    def _dispatch_ragged(self, buckets, states, results, problems,
+                         deadline, profile, evidence, stats) -> None:
+        """Ragged paged dispatch: the window's admitted units (monoliths
+        and projected components alike) pack into flat streams — chunked
+        only by the memory/round budgets, never by shape — and each
+        stream ships as ONE guarded kernel launch through the
+        device.dispatch fault seam (injection, hard deadline, breaker).
+        Evidence mode bounds ragged WINDOWS per process
+        (ragged_window_cap) instead of queries per dispatch: amortizing
+        the whole window per launch is the point of the ragged pack, so
+        the bucketed slot cap does not apply."""
+        from mythril_tpu.resilience import breaker as breaker_mod
+
+        window = [unit for level in sorted(buckets)
+                  for unit in buckets[level]]
+        if not window:
+            return
+        ragged_profile = {k: v for k, v in profile.items()
+                          if k in ("num_restarts", "steps")}
+        for group in self._chunk_ragged(window):
+            if ((evidence and self.ragged_windows >= self.ragged_window_cap)
+                    or self._unavailable
+                    or self._breaker.state == breaker_mod.OPEN):
+                break
+            remaining = deadline - time.monotonic()
+            if remaining <= 0.1:
+                break  # host settles the rest — the deadline guarantee
+            t0 = time.monotonic()
+            try:
+                group_bits = self._guarded_ragged_dispatch(
+                    group, remaining, ragged_profile)
+            except StageDeadlineExceeded:
+                self.record_deadline_trip()
+                break
+            except Exception as error:
+                log.warning("ragged device dispatch failed (%s); "
+                            "CDCL fallback", error)
+                self.record_dispatch(0, time.monotonic() - t0,
+                                     errored=True, ragged=True)
+                continue
+            elapsed = time.monotonic() - t0
+            hits = sum(1 for bits in group_bits if bits is not None)
+            if stats is not None:
+                # no query-axis padding on a ragged stream: slots == cones
+                stats.add_device_dispatch(len(group), len(group), elapsed)
+            self.record_dispatch(hits, elapsed, ragged=True)
+            self._apply_group_bits(group, group_bits, results, states,
+                                   problems, stats)
 
     def _admission(self, pc, caps) -> str:
         """THE device-admission policy, shared by monolithic queries and
@@ -851,6 +1204,29 @@ class QueryRouter:
             return "cost"
         return "device"
 
+    def _admission_ragged(self, pc) -> str:
+        """Ragged-mode admission: the SHAPE caps become MEMORY-BUDGET
+        checks. "tiny" keeps the propagation-only host shortcut; "cap"
+        now means the cone's estimated stream contribution alone busts
+        the per-stream memory budget (no level ceiling — a 600-level
+        cone the bucketed caps would reject packs like any other);
+        "cost" means one ragged round over just this cone's REAL gates
+        plus the amortized stream prep already blows the round budget.
+        Cones inside the level x cell floor stay exempt from the cost
+        check — the round-5 admission guarantee holds in both modes."""
+        if pc.num_levels <= self.host_direct_levels:
+            return "tiny"
+        if self.ragged_entry_bytes(pc) > self.ragged_stream_budget:
+            return "cap"
+        under_floor = (pc.num_levels <= LEVEL_CAP_FLOOR
+                       and pc.num_levels * pc.max_width <= self.CELL_FLOOR)
+        if (not under_floor
+                and self.est_ragged_round_seconds(self.ragged_round_cells(pc))
+                + self.ragged_prep_overhead_seconds()
+                > self.ragged_chunk_budget_s()):
+            return "cost"
+        return "device"
+
     # -- per-component root projection (preanalysis/aig_partition) ----------
 
     @staticmethod
@@ -866,7 +1242,8 @@ class QueryRouter:
             return None  # partitioning must never break routing
 
     def _plan_components(self, qi, num_vars, aig_roots, partition, caps,
-                         buckets, stats) -> Optional["_SplitState"]:
+                         buckets, stats,
+                         ragged: bool = False) -> Optional["_SplitState"]:
         """Project a partitioned query onto dispatch units: trivial
         components (all-unit root sets) write their literals into the
         merge state directly, device-eligible components join the level
@@ -898,7 +1275,10 @@ class QueryRouter:
                 # never projects constant roots, so it cannot mean a
                 # trivially-unsat root set — and routes host like any
                 # other ineligible component
-                if pc.ok and self._admission(pc, caps) == "device":
+                verdict = (self._admission_ragged(pc) if ragged
+                           else self._admission(pc, caps)) if pc.ok \
+                    else "cap"
+                if verdict == "device":
                     buckets.setdefault(
                         shape_bucket(pc.num_levels), []).append(unit)
                 else:

@@ -57,25 +57,31 @@ def test_batch_results_cached():
 
 
 def test_batch_rides_one_device_call(monkeypatch):
-    """N same-shape device-worthy queries -> exactly ONE bucketed
-    circuit-batch fan-out (the router groups them into one padded batch).
-    Pins the competitive (real-accelerator) contract: the CPU platform's
-    evidence mode intentionally trims dispatches instead (test_router.py)."""
+    """N same-shape device-worthy queries -> exactly ONE device fan-out:
+    one ragged flat stream under the default dispatch mode (the whole
+    window is one launch by construction). Pins the competitive
+    (real-accelerator) contract: the CPU platform's evidence mode
+    intentionally trims dispatches instead (test_router.py)."""
     from mythril_tpu.tpu import backend as backend_mod
     from mythril_tpu.tpu.router import QueryRouter, get_router
 
     args.solver_backend = "tpu"
     monkeypatch.setattr(QueryRouter, "_evidence_mode", lambda self: False)
-    get_router()  # instantiate under the patched profile
+    router = get_router()  # instantiate under the patched profile
+    # pin the cost model: a slow in-process calibration measurement on a
+    # loaded machine must not chunk-split or cost-reject the 6-cone
+    # window — the single-launch contract is what this test pins
+    router._calibrated = True
+    router._per_cell_s = 1e-12
     device = backend_mod.get_device_backend()
     calls = []
-    real = device.try_solve_batch_circuit
+    real = device.try_solve_batch_ragged
 
     def spy(problems, **kwargs):
         calls.append(len(problems))
         return real(problems, **kwargs)
 
-    monkeypatch.setattr(device, "try_solve_batch_circuit", spy)
+    monkeypatch.setattr(device, "try_solve_batch_ragged", spy)
 
     queries = []
     for i in range(6):

@@ -328,6 +328,35 @@ def test_device_dispatch_wedged_backend_trips_deadline_and_breaker(
     assert SolverStatistics().resilience_deadline_trips >= 1
 
 
+def test_ragged_dispatch_fault_degrades_to_host_cdcl(monkeypatch):
+    """The ragged paged dispatch (and its in-call cube settle) rides the
+    SAME device.dispatch fault site as the bucketed path: with ragged
+    pinned ON, an injected raise on every crossing must degrade every
+    query to the host CDCL with verdicts identical to the no-fault
+    ragged baseline — and the ragged stream must be what was faulted
+    (the window was admitted, not cap-rejected away)."""
+    monkeypatch.setenv("MYTHRIL_TPU_RAGGED", "1")
+    stats = SolverStatistics()
+    assert _seam_outcomes() == ["sat"] * 4  # no-fault ragged baseline
+    assert stats.cap_rejects == 0, \
+        "ragged admission must not shape-reject production cones"
+    assert stats.ragged_windows >= 1, \
+        "the baseline must actually exercise the ragged stream path"
+    _full_reset()
+    stats.reset()
+    stats.enabled = True
+    faults.configure("device.dispatch:raise:*")
+    try:
+        assert _seam_outcomes() == ["sat"] * 4, \
+            "host CDCL must settle every query the ragged path drops"
+    finally:
+        faults.configure(None)
+    recorded = _events("device.dispatch")
+    assert recorded.get("injected", 0) >= 1, recorded
+    assert stats.ragged_windows == 0, \
+        "a faulted ragged window must not count as dispatched"
+
+
 # -- --jobs worker death (core.py) ---------------------------------------------
 
 

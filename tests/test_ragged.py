@@ -1,0 +1,348 @@
+"""Ragged paged device dispatch (tpu/circuit.RaggedStream +
+run_round_ragged) and cube-and-conquer (preanalysis/cubes.py).
+
+Three layers:
+  * stream layout — variable-disjoint pages, real-gate concatenation
+    (padding stripped), paged root tables, cube assumption roots;
+  * kernel correctness — every model the ragged kernel reports
+    satisfies its cone (independently re-evaluated on the host AIG),
+    including cube replicas whose pinned literals must be honored;
+  * end-to-end — the real DeviceSolverBackend's ragged window entry
+    point, the roofline "ragged" stage emission, and full-analyze
+    findings parity with ragged on vs off (the acceptance invariant).
+
+Router-policy unit tests (admission, chunking, caps) live in
+tests/test_router.py; the chaos degradation test in tests/test_chaos.py.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from mythril_tpu.preanalysis import cubes as cubes_mod
+from mythril_tpu.smt.bitblast import AIG
+from mythril_tpu.smt.solver.statistics import SolverStatistics
+from mythril_tpu.tpu.circuit import PackedCircuit, RaggedStream
+
+
+@pytest.fixture(autouse=True)
+def fresh_stats():
+    stats = SolverStatistics()
+    stats.reset()
+    stats.enabled = True
+    yield
+    stats.reset()
+
+
+def _random_cone(rng, n_inputs, n_gates):
+    """A random AND/INV cone asserting its last gate — satisfiable
+    unless structural hashing collapses it to a constant (the builders
+    below retry until PackedCircuit accepts the root set)."""
+    aig = AIG()
+    lits = [aig.lit_of_var(aig.new_var()) for _ in range(n_inputs)]
+    for _ in range(n_gates):
+        a = rng.choice(lits) ^ rng.randint(0, 1)
+        b = rng.choice(lits) ^ rng.randint(0, 1)
+        lits.append(aig.and_gate(a, b))
+    return aig, [lits[-1]]
+
+
+def _bruteforce_sat(aig, roots):
+    """Host ground truth: is the root set satisfiable? Input spaces here
+    are tiny (<= 10 inputs), so exhaustive enumeration is exact."""
+    inputs = [v for v in range(1, aig.num_vars + 1)
+              if aig.gate_lhs[v] == -1]
+    for pattern in range(1 << len(inputs)):
+        assignment = {v: bool((pattern >> i) & 1)
+                      for i, v in enumerate(inputs)}
+        if all(_eval_root(aig, assignment, root) for root in roots):
+            return True
+    return False
+
+
+def _packed_cones(rng, count):
+    """`count` packed cones, each verified SATISFIABLE by exhaustive
+    host evaluation — a random AND cone can collapse to a contradiction
+    strashing does not see, and these tests assert the kernel FINDS
+    models, so UNSAT cones must not enter."""
+    cones = []
+    while len(cones) < count:
+        aig, roots = _random_cone(rng, 4 + len(cones), 10 + 9 * len(cones))
+        pc = PackedCircuit(aig, roots)
+        if pc.ok and _bruteforce_sat(aig, roots):
+            cones.append((aig, roots, pc))
+    return cones
+
+
+def _eval_root(aig, assignment, lit):
+    """Host re-evaluation oracle: does `assignment` (global var -> bool)
+    satisfy root literal `lit` on the original AIG?"""
+    import sys
+
+    sys.setrecursionlimit(100000)
+    var, neg = lit >> 1, lit & 1
+
+    def val(v):
+        if v == 0:
+            return False
+        lhs, rhs = aig.gate_lhs[v], aig.gate_rhs[v]
+        if lhs == -1:
+            return assignment.get(v, False)
+        return ((val(lhs >> 1) ^ bool(lhs & 1))
+                and (val(rhs >> 1) ^ bool(rhs & 1)))
+
+    return val(var) ^ bool(neg)
+
+
+def _local_to_global(pc, local):
+    return {int(gvar): bool(local[lvar])
+            for lvar, gvar in enumerate(pc.var_map) if lvar > 0}
+
+
+# -- stream layout -----------------------------------------------------------
+
+
+def test_stream_pages_are_disjoint_and_cover_every_cone():
+    rng = random.Random(11)
+    cones = _packed_cones(rng, 6)
+    stream = RaggedStream([(pc, ()) for _a, _r, pc in cones])
+    assert stream.ok and stream.num_cones == 6
+    spans = sorted(stream.pages)
+    for (base_a, size_a), (base_b, _sb) in zip(spans, spans[1:]):
+        assert base_a + size_a <= base_b, "variable pages must not alias"
+    assert all(size == pc.v1 - 1
+               for (_b, size), (_a, _r, pc) in zip(stream.pages, cones))
+    # combined var space fits the bucketed v1 and leaves var 0 shared
+    assert stream.v1 >= 1 + sum(pc.v1 - 1 for _a, _r, pc in cones)
+
+
+def test_stream_strips_per_level_padding_to_real_gates():
+    """The combined level rows carry each cone's REAL gates, so the
+    simulated cell volume is the window's summed gate count — never
+    levels x max_width x cones (the bucketed padding the ragged pack
+    exists to remove)."""
+    rng = random.Random(13)
+    cones = _packed_cones(rng, 5)
+    stream = RaggedStream([(pc, ()) for _a, _r, pc in cones])
+    live_rows = int((stream.tensors["out_idx"] > 0).sum())
+    assert live_rows == sum(pc.num_gates for _a, _r, pc in cones)
+    assert stream.nbytes > 0
+
+
+def test_padding_cone_slots_carry_empty_root_masks():
+    rng = random.Random(17)
+    cones = _packed_cones(rng, 3)
+    stream = RaggedStream([(pc, ()) for _a, _r, pc in cones])
+    assert stream.cone_slots >= 4  # pow2 ramp over 3 real cones
+    mask = stream.tensors["root_mask"]
+    assert mask[3:].sum() == 0, "padding slots must assert nothing"
+
+
+# -- kernel correctness ------------------------------------------------------
+
+
+def _run_stream(stream, steps=64, restarts=8, seed=0):
+    import jax
+
+    from mythril_tpu.tpu.circuit import run_round_ragged
+
+    jnp = jax.numpy
+    tensors = {k: jnp.asarray(v) for k, v in stream.tensors.items()}
+    key = jax.random.PRNGKey(seed)
+    key, init_key = jax.random.split(key)
+    x = jax.random.bernoulli(
+        init_key, 0.5, (restarts, stream.v1)).astype(jnp.int32)
+    x, found = run_round_ragged(
+        tensors, x, key, steps=steps,
+        walk_depth=stream.num_levels + 4)
+    return np.asarray(x), np.asarray(found)
+
+
+def test_ragged_kernel_models_satisfy_their_cones():
+    """Every (cone, lane) the kernel flags found must decode to an
+    assignment the host AIG evaluation confirms — per cone, out of ONE
+    combined launch over all of them."""
+    rng = random.Random(23)
+    cones = _packed_cones(rng, 5)
+    stream = RaggedStream([(pc, ()) for _a, _r, pc in cones])
+    x, found = _run_stream(stream)
+    assert found.any(axis=0)[: len(cones)].all(), \
+        "tiny random cones must all settle within one round"
+    for ci, (aig, roots, pc) in enumerate(cones):
+        lane = int(np.argmax(found[:, ci]))
+        assignment = _local_to_global(
+            pc, stream.cone_assignment(ci, x[lane]))
+        for root in roots:
+            assert _eval_root(aig, assignment, root), (ci, root)
+
+
+def test_cube_assumptions_are_honored_as_extra_roots():
+    """Cube replicas of one cone ride a stream with their split literals
+    pinned: a found cube model must satisfy the cone AND every pinned
+    literal (the soundness argument: a cube model IS a cone model)."""
+    rng = random.Random(29)
+    (aig, roots, pc), = _packed_cones(rng, 1)
+    plan = cubes_mod.plan_cubes(pc, 3, 1000)
+    assert len(plan) == 8
+    stream = RaggedStream([(pc, cube) for cube in plan])
+    x, found = _run_stream(stream, steps=96)
+    solved = found.any(axis=0)[: len(plan)]
+    assert solved.any(), "at least one cube of a SAT cone must settle"
+    for ci, cube in enumerate(plan):
+        if not solved[ci]:
+            continue  # a cube may genuinely be UNSAT (pinned both ways)
+        lane = int(np.argmax(found[:, ci]))
+        local = stream.cone_assignment(ci, x[lane])
+        assignment = _local_to_global(pc, local)
+        for root in roots:
+            assert _eval_root(aig, assignment, root), ("cube", ci)
+        for lvar, want in cube:
+            assert bool(local[lvar]) == want, ("pinned literal", ci, lvar)
+
+
+# -- cube selection ----------------------------------------------------------
+
+
+def test_cube_vars_are_top_fanout_inputs_deterministic():
+    rng = random.Random(31)
+    (_aig, _roots, pc), = _packed_cones(rng, 1)
+    chosen = cubes_mod.select_cube_vars(pc, 3)
+    assert chosen == cubes_mod.select_cube_vars(pc, 3), \
+        "selection must be deterministic"
+    fanout = (np.bincount(pc.ga_var, minlength=pc.v1)
+              + np.bincount(pc.gb_var, minlength=pc.v1))
+    inputs = [v for v in range(1, pc.v1)
+              if pc.is_gate[v] == 0 and fanout[v] > 0]
+    assert set(chosen) <= set(inputs), "only cone INPUTS are splittable"
+    worst_chosen = min(fanout[v] for v in chosen)
+    assert all(fanout[v] <= worst_chosen
+               for v in inputs if v not in chosen), \
+        "chosen vars must dominate every unchosen input by fanout"
+
+
+def test_cube_plan_respects_replica_budget():
+    rng = random.Random(37)
+    (_aig, _roots, pc), = _packed_cones(rng, 1)
+    assert len(cubes_mod.plan_cubes(pc, 5, max_cubes=7)) == 4  # 2^2 <= 7
+    assert cubes_mod.plan_cubes(pc, 5, max_cubes=1) == []
+    assert cubes_mod.plan_cubes(pc, 0, max_cubes=64) == []
+
+
+# -- backend + roofline end to end -------------------------------------------
+
+
+def test_backend_ragged_window_entry_point_and_counters():
+    """The real backend's try_solve_batch_ragged: one window of real
+    cones in, per-query model bits out (host clause check passed),
+    ragged counters and the singleton's ragged_windows advanced, and
+    the roofline's "ragged" stage row carries the stream bytes."""
+    from mythril_tpu.observe import roofline
+    from mythril_tpu.tpu import backend as backend_mod
+
+    rng = random.Random(41)
+    cones = _packed_cones(rng, 3)
+    problems = [(aig.num_vars, [], (aig, roots))
+                for aig, roots, _pc in cones]
+    backend = backend_mod.get_device_backend()
+    before_windows = backend.ragged_windows
+    stats = SolverStatistics()
+    results = backend.try_solve_batch_ragged(problems, budget_seconds=20.0,
+                                             num_restarts=8, steps=64)
+    assert all(bits is not None for bits in results)
+    for (aig, roots, _pc), bits in zip(cones, results):
+        assignment = {v: bits[v] for v in range(1, aig.num_vars + 1)}
+        for root in roots:
+            assert _eval_root(aig, assignment, root)
+    assert backend.ragged_windows == before_windows + 1
+    assert backend.paged_stream_bytes > 0
+    assert stats.ragged_windows >= 1
+    assert stats.ragged_cones_packed >= 3
+    assert stats.paged_stream_bytes > 0
+    row = roofline.build(stats)["stages"]["ragged"]
+    assert row["units"] == "bytes/s"
+    assert row["work"] == backend.paged_stream_bytes
+
+
+def test_backend_cube_pass_settles_missed_cone(monkeypatch):
+    """A cone the plain rounds miss gets the cube-and-conquer second
+    pass inside the SAME window call: deterministically forced here by
+    making the first stream solve (the plain pass) return empty, so the
+    cube replicas must produce the model. cubes_dispatched counts the
+    replicas, and the returned bits still pass the host re-evaluation."""
+    from mythril_tpu.tpu import backend as backend_mod
+
+    rng = random.Random(43)
+    (aig, roots, _pc), = _packed_cones(rng, 1)
+    problems = [(aig.num_vars, [], (aig, roots))]
+    backend = backend_mod.get_device_backend()
+    real_solve = backend._solve_ragged_stream
+    calls = []
+
+    def miss_first(jax, circuit, entries, deadline, num_restarts, steps,
+                   **kwargs):
+        calls.append(len(entries))
+        if len(calls) == 1:
+            return {}, 0, True  # plain pass: forced miss
+        return real_solve(jax, circuit, entries, deadline,
+                          num_restarts, steps, **kwargs)
+
+    monkeypatch.setattr(backend, "_solve_ragged_stream", miss_first)
+    stats = SolverStatistics()
+    results = backend.try_solve_batch_ragged(
+        problems, budget_seconds=20.0, num_restarts=8, steps=96,
+        cube_vars=2, cube_min_levels=0)
+    assert len(calls) >= 2, "the missed cone must get a cube pass"
+    assert calls[1] == 4, "2^2 cube replicas ride the second stream"
+    assert stats.cubes_dispatched == 4
+    assert stats.cube_device_refutes <= 4
+    assert results[0] is not None, "a cube model settles the query"
+    assignment = {v: results[0][v] for v in range(1, aig.num_vars + 1)}
+    for root in roots:
+        assert _eval_root(aig, assignment, root)
+
+
+# -- full-analyze findings parity (the acceptance invariant) -----------------
+
+
+def test_analyze_findings_identical_ragged_on_off(monkeypatch):
+    """KILLBILLY under --solver-backend=tpu: canonical findings bytes
+    must be identical with ragged dispatch on (default) and off
+    (--no-ragged semantics via the env override)."""
+    import json
+
+    from mythril_tpu.core import MythrilAnalyzer, MythrilDisassembler
+    from mythril_tpu.support.args import args as global_args
+    from tests.test_analysis import KILLBILLY
+
+    monkeypatch.setattr(global_args, "solver_backend", "tpu")
+
+    class _Args:
+        execution_timeout = 60
+        transaction_count = 2
+        max_depth = 128
+        pruning_factor = 1.0
+
+    def canonical():
+        from mythril_tpu import preanalysis
+        from mythril_tpu.support.model import clear_caches
+        from mythril_tpu.tpu import router as router_mod
+
+        clear_caches()
+        preanalysis.reset_caches()
+        router_mod.reset_router()
+        disassembler = MythrilDisassembler()
+        disassembler.load_from_bytecode(KILLBILLY)
+        analyzer = MythrilAnalyzer(disassembler, cmd_args=_Args(),
+                                   strategy="bfs")
+        report = analyzer.fire_lasers(transaction_count=2)
+        issues = json.loads(report.as_json())["issues"]
+        return json.dumps(
+            sorted(issues, key=lambda i: json.dumps(i, sort_keys=True)),
+            sort_keys=True)
+
+    monkeypatch.setenv("MYTHRIL_TPU_RAGGED", "1")
+    on = canonical()
+    monkeypatch.setenv("MYTHRIL_TPU_RAGGED", "0")
+    off = canonical()
+    assert on == off, "findings must be byte-identical ragged on/off"

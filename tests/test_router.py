@@ -15,11 +15,15 @@ from mythril_tpu.tpu.router import LEVEL_CAP_FLOOR, QueryRouter
 
 
 class FakePC:
-    def __init__(self, levels, v1=100, width=4, ok=True):
+    def __init__(self, levels, v1=100, width=4, ok=True, num_gates=None):
         self.num_levels = levels
         self.v1 = v1
         self.max_width = width
         self.ok = ok
+        # real gate count (the ragged cost model's work unit); the padded
+        # product is the conservative stand-in real PackedCircuits beat
+        self.num_gates = (levels * width if num_gates is None
+                          else num_gates)
 
 
 class FakeJax:
@@ -39,6 +43,7 @@ class FakeBackend:
         self._available = available
         self.answers = answers or {}
         self.dispatch_log = []  # (problem ids, budget, kwargs)
+        self.ragged_log = []    # same shape, ragged flat-stream dispatches
         self.cap_rejects = 0
 
     def available(self):
@@ -69,6 +74,12 @@ class FakeBackend:
     def try_solve_batch_circuit(self, problems, budget_seconds=4.0,
                                 size_caps=None, **kwargs):
         self.dispatch_log.append(
+            ([id(p[2]) for p in problems], budget_seconds, kwargs))
+        return [self.answers.get(id(p[2])) for p in problems]
+
+    def try_solve_batch_ragged(self, problems, budget_seconds=4.0,
+                               **kwargs):
+        self.ragged_log.append(
             ([id(p[2]) for p in problems], budget_seconds, kwargs))
         return [self.answers.get(id(p[2])) for p in problems]
 
@@ -119,7 +130,8 @@ def test_level_cap_env_override(monkeypatch):
     assert (level, var) == (123, 456)
 
 
-def test_oversize_cones_counted_not_silent():
+def test_oversize_cones_counted_not_silent(monkeypatch):
+    monkeypatch.setenv("MYTHRIL_TPU_RAGGED", "0")  # bucketed shape caps
     stats = SolverStatistics()
     backend = FakeBackend()
     router = QueryRouter(backend)
@@ -147,10 +159,11 @@ def test_tiny_cones_host_direct():
     assert backend.dispatch_log == []
 
 
-def test_cost_model_deadline_fallback():
+def test_cost_model_deadline_fallback(monkeypatch):
     """An above-floor cone whose ESTIMATED round time exceeds the round
     budget is never shipped — the host takes it (deadline fallback),
     counted as a cap reject so the drop is visible."""
+    monkeypatch.setenv("MYTHRIL_TPU_RAGGED", "0")  # bucketed cost model
     backend = FakeBackend()
     router = QueryRouter(backend)
     router._per_cell_s = 1.0  # pathological measured latency: 1 s/level
@@ -160,10 +173,11 @@ def test_cost_model_deadline_fallback():
     assert backend.dispatch_log == []
 
 
-def test_floor_cones_exempt_from_cost_model():
+def test_floor_cones_exempt_from_cost_model(monkeypatch):
     """Cones at or under the level floor are the round-5 guarantee: even a
     pathological latency measurement must not re-create the old
     reject-everything behavior for production analyze cones."""
+    monkeypatch.setenv("MYTHRIL_TPU_RAGGED", "0")  # bucketed dispatch log
     backend = FakeBackend(answers={})
     router = QueryRouter(backend)
     router._per_cell_s = 1.0
@@ -172,7 +186,8 @@ def test_floor_cones_exempt_from_cost_model():
     assert len(backend.dispatch_log) == 1
 
 
-def test_dispatch_budget_bounded_by_deadline_and_timeout():
+def test_dispatch_budget_bounded_by_deadline_and_timeout(monkeypatch):
+    monkeypatch.setenv("MYTHRIL_TPU_RAGGED", "0")  # bucketed dispatch log
     backend = FakeBackend()
     router = QueryRouter(backend)
     pc = FakePC(500)
@@ -206,6 +221,7 @@ def test_hits_reset_the_waste_meter(monkeypatch):
 def test_evidence_mode_dispatch_cap(monkeypatch):
     """On the CPU platform the device fires a bounded number of times per
     process, then the host takes everything — the wall-clock guarantee."""
+    monkeypatch.setenv("MYTHRIL_TPU_RAGGED", "0")  # per-dispatch cap
     monkeypatch.setenv("MYTHRIL_TPU_CPU_DISPATCH_CAP", "2")
     pc1, pc2, pc3 = FakePC(500), FakePC(500), FakePC(500)
     backend = FakeBackend(answers={id(pc1): [True], id(pc2): [True],
@@ -221,6 +237,7 @@ def test_evidence_mode_trims_dispatch_to_slot_cap(monkeypatch):
     """On the CPU platform round wall scales with padded q (serialized
     lanes): a big sibling group is trimmed to the slot cap, the overflow
     goes to the host — counted, never silent."""
+    monkeypatch.setenv("MYTHRIL_TPU_RAGGED", "0")  # bucketed slot cap
     monkeypatch.setenv("MYTHRIL_TPU_CPU_BATCH_SLOTS", "2")
     stats = SolverStatistics()
     pcs = [FakePC(500) for _ in range(5)]
@@ -235,7 +252,8 @@ def test_evidence_mode_trims_dispatch_to_slot_cap(monkeypatch):
     assert stats.router_host_direct == 0
 
 
-def test_evidence_profile_shrinks_device_work():
+def test_evidence_profile_shrinks_device_work(monkeypatch):
+    monkeypatch.setenv("MYTHRIL_TPU_RAGGED", "0")  # bucketed profile kwargs
     backend = FakeBackend()
     router = QueryRouter(backend)
     router.dispatch([problem(FakePC(500))], timeout_s=10.0)
@@ -248,6 +266,7 @@ def test_evidence_profile_shrinks_device_work():
 def test_level_bucketed_dispatch_groups(monkeypatch):
     """Mixed-depth batches split into per-bucket dispatches: one deep cone
     must not force every sibling to pad to its shape."""
+    monkeypatch.setenv("MYTHRIL_TPU_RAGGED", "0")  # bucketed grouping
     monkeypatch.setenv("MYTHRIL_TPU_CPU_DISPATCH_CAP", "10")
     monkeypatch.setenv("MYTHRIL_TPU_CPU_BATCH_SLOTS", "8")
     stats = SolverStatistics()
@@ -267,3 +286,187 @@ def test_level_bucketed_dispatch_groups(monkeypatch):
     assert stats.device_dispatches == 2
     assert stats.device_dispatched_queries == 4
     assert stats.device_slots == 4 + 1  # pow2 padding: 3->4, 1->1
+
+
+# -- ragged paged dispatch (the default mode) --------------------------------
+
+
+def test_ragged_formerly_cap_rejected_deep_cone_is_admitted(monkeypatch):
+    """THE tentpole invariant at unit level: a ~600-level cone past the
+    bucketed level cap was cap-rejected outright; under ragged admission
+    the shape caps are memory-budget checks, so the same cone packs like
+    any other (its estimated stream contribution is kilobytes against a
+    48 MiB budget)."""
+    monkeypatch.setenv("MYTHRIL_TPU_LEVEL_CAP", "512")
+    deep = FakePC(600)
+    backend = FakeBackend(answers={id(deep): [True]})
+
+    monkeypatch.setenv("MYTHRIL_TPU_RAGGED", "0")
+    router = QueryRouter(backend)
+    assert router.dispatch([problem(deep)], timeout_s=10.0) == [None]
+    assert backend.cap_rejects == 1, "bucketed caps reject the deep cone"
+    assert backend.ragged_log == [] and backend.dispatch_log == []
+
+    monkeypatch.setenv("MYTHRIL_TPU_RAGGED", "1")
+    router_mod.reset_router()
+    backend = FakeBackend(answers={id(deep): [True]})
+    router = QueryRouter(backend)
+    assert router.dispatch([problem(deep)], timeout_s=10.0) == [[True]]
+    assert backend.cap_rejects == 0
+    assert len(backend.ragged_log) == 1
+
+
+def test_ragged_one_launch_covers_mixed_shapes(monkeypatch):
+    """The level-bucketed path split mixed-depth windows into per-bucket
+    dispatches; the ragged stream ships shallow and deep cones in ONE
+    launch (slots == cones: no pow2 query padding in the occupancy)."""
+    monkeypatch.setenv("MYTHRIL_TPU_RAGGED", "1")
+    stats = SolverStatistics()
+    pcs = [FakePC(130), FakePC(140), FakePC(135), FakePC(540)]
+    backend = FakeBackend(answers={id(pc): [True] for pc in pcs})
+    router = QueryRouter(backend)
+    results = router.dispatch([problem(pc) for pc in pcs],
+                              timeout_s=10.0, stats=stats)
+    assert results == [[True]] * 4
+    assert len(backend.ragged_log) == 1, "one flat stream, one launch"
+    assert len(backend.ragged_log[0][0]) == 4
+    assert backend.dispatch_log == []
+    assert stats.device_dispatches == 1
+    assert stats.device_slots == 4, "ragged slots == cones, no padding"
+
+
+def test_ragged_memory_budget_is_the_admission_cap(monkeypatch):
+    """Ragged admission rejects on BYTES, not shape: a cone whose
+    estimated stream contribution alone busts the per-stream budget is
+    turned away (counted), its siblings still ride."""
+    monkeypatch.setenv("MYTHRIL_TPU_RAGGED", "1")
+    small = FakePC(300)                 # 1.2k gates
+    huge = FakePC(300, width=400)       # 120k gates
+    # budget sized between the two estimated contributions: huge alone
+    # busts it, small rides
+    monkeypatch.setenv(
+        "MYTHRIL_TPU_RAGGED_STREAM_BYTES",
+        str(QueryRouter.ragged_entry_bytes(small) + 1))
+    backend = FakeBackend(answers={id(small): [True], id(huge): [True]})
+    router = QueryRouter(backend)
+    stats = SolverStatistics()
+    results = router.dispatch([problem(small), problem(huge)],
+                              timeout_s=10.0, stats=stats)
+    assert results == [[True], None]
+    assert backend.cap_rejects == 1, "over-budget cone counted, not silent"
+    assert len(backend.ragged_log) == 1
+    assert len(backend.ragged_log[0][0]) == 1
+
+
+def test_ragged_windows_chunk_to_stream_budget(monkeypatch):
+    """A window whose summed bytes overflow the stream budget chunks into
+    several launches — admission is per cone, chunking is per window."""
+    monkeypatch.setenv("MYTHRIL_TPU_RAGGED", "1")
+    pcs = [FakePC(300) for _ in range(4)]
+    entry = QueryRouter.ragged_entry_bytes(pcs[0])
+    # budget fits exactly two entries per stream
+    monkeypatch.setenv("MYTHRIL_TPU_RAGGED_STREAM_BYTES",
+                       str(2 * entry + 1))
+    backend = FakeBackend(answers={id(pc): [True] for pc in pcs})
+    router = QueryRouter(backend)
+    results = router.dispatch([problem(pc) for pc in pcs], timeout_s=10.0)
+    assert results == [[True]] * 4
+    assert [len(ids) for ids, _b, _k in backend.ragged_log] == [2, 2]
+
+
+def test_ragged_windows_chunk_to_kernel_var_cap(monkeypatch):
+    """A window whose concatenated variable pages would overflow the
+    kernel compile cap (circuit.MAX_VARS) chunks into several streams —
+    the per-cone pack cap bounds each page, so only the chunker can
+    re-enforce the cap for the combined space."""
+    from mythril_tpu.tpu import circuit as circuit_mod
+
+    monkeypatch.setenv("MYTHRIL_TPU_RAGGED", "1")
+    # two 99-var pages fit a 250-var cap (1 + 198), three bust it
+    monkeypatch.setattr(circuit_mod, "MAX_VARS", 250)
+    pcs = [FakePC(300, v1=100) for _ in range(4)]
+    backend = FakeBackend(answers={id(pc): [True] for pc in pcs})
+    router = QueryRouter(backend)
+    results = router.dispatch([problem(pc) for pc in pcs], timeout_s=10.0)
+    assert results == [[True]] * 4
+    assert [len(ids) for ids, _b, _k in backend.ragged_log] == [2, 2]
+
+
+def test_ragged_cost_model_charges_real_gates_not_padded_cells(monkeypatch):
+    """The bucketed cost model charged levels x max_width (the padded
+    ceiling); the ragged model charges the REAL gate count the stream
+    carries. A deep-but-sparse cone (few gates per level) that the padded
+    estimate would reject under a pathological latency is admitted."""
+    monkeypatch.setenv("MYTHRIL_TPU_RAGGED", "1")
+    sparse = FakePC(700, width=1024, num_gates=1400)  # 2 gates/level
+    backend = FakeBackend(answers={id(sparse): [True]})
+    router = QueryRouter(backend)
+    # latency at which the PADDED estimate (700*1024 cells) blows the
+    # 4 s round budget but the real-row rectangle (768 x 64 after
+    # bucketing a 2-gates-per-level cone) stays inside the chunk budget
+    router._per_cell_s = 4.0 / (router._profile_steps() * 2 * 700 * 1024)
+    assert router.est_round_seconds(700, 1024) >= router.round_budget_s
+    assert (router.est_ragged_round_seconds(
+        router.ragged_round_cells(sparse))
+        < router.ragged_chunk_budget_s())
+    assert router.dispatch([problem(sparse)], timeout_s=10.0) == [[True]]
+    assert backend.cap_rejects == 0
+
+
+def test_ragged_window_cap_bounds_evidence_mode(monkeypatch):
+    """On the CPU platform ragged windows get their own per-process
+    evidence cap (one launch amortizes a whole window, so the bucketed
+    per-dispatch cap does not apply); past it the host takes everything."""
+    monkeypatch.setenv("MYTHRIL_TPU_RAGGED", "1")
+    monkeypatch.setenv("MYTHRIL_TPU_RAGGED_WINDOW_CAP", "2")
+    pcs = [FakePC(500) for _ in range(3)]
+    backend = FakeBackend(answers={id(pc): [True] for pc in pcs})
+    router = QueryRouter(backend)
+    assert router.dispatch([problem(pcs[0])], timeout_s=10.0) == [[True]]
+    assert router.dispatch([problem(pcs[1])], timeout_s=10.0) == [[True]]
+    assert router.dispatch([problem(pcs[2])], timeout_s=10.0) == [None]
+    assert len(backend.ragged_log) == 2
+
+
+def test_ragged_flag_and_env_gate(monkeypatch):
+    """--no-ragged restores bucketed dispatch; MYTHRIL_TPU_RAGGED
+    overrides the flag in both directions."""
+    from mythril_tpu.support.args import args
+
+    monkeypatch.delenv("MYTHRIL_TPU_RAGGED", raising=False)
+    monkeypatch.setattr(args, "no_ragged", False)
+    assert router_mod.ragged_enabled()
+    monkeypatch.setattr(args, "no_ragged", True)
+    assert not router_mod.ragged_enabled()
+    monkeypatch.setenv("MYTHRIL_TPU_RAGGED", "1")
+    assert router_mod.ragged_enabled(), "env force-enable beats the flag"
+    monkeypatch.setattr(args, "no_ragged", False)
+    monkeypatch.setenv("MYTHRIL_TPU_RAGGED", "0")
+    assert not router_mod.ragged_enabled()
+
+
+def test_ragged_scheduler_window_widens(monkeypatch):
+    """With ragged dispatch live ON THE DEVICE BACKEND the coalescing
+    scheduler's default window widens (one launch covers the whole
+    window); host-only runs, the explicit env override, and the bucketed
+    default are unchanged."""
+    from mythril_tpu.service import scheduler as sched_mod
+    from mythril_tpu.support.args import args
+
+    monkeypatch.delenv("MYTHRIL_TPU_COALESCE_MAX", raising=False)
+    monkeypatch.setenv("MYTHRIL_TPU_RAGGED", "1")
+    monkeypatch.setattr(args, "solver_backend", "tpu")
+    assert (sched_mod.CoalescingScheduler().max_batch
+            == sched_mod.DEFAULT_COALESCE_MAX_RAGGED)
+    # host-only backend: ragged can never engage, widening would only
+    # add flush latency
+    monkeypatch.setattr(args, "solver_backend", "cpu")
+    assert (sched_mod.CoalescingScheduler().max_batch
+            == sched_mod.DEFAULT_COALESCE_MAX)
+    monkeypatch.setattr(args, "solver_backend", "tpu")
+    monkeypatch.setenv("MYTHRIL_TPU_RAGGED", "0")
+    assert (sched_mod.CoalescingScheduler().max_batch
+            == sched_mod.DEFAULT_COALESCE_MAX)
+    monkeypatch.setenv("MYTHRIL_TPU_RAGGED", "1")
+    monkeypatch.setenv("MYTHRIL_TPU_COALESCE_MAX", "5")
+    assert sched_mod.CoalescingScheduler().max_batch == 5
