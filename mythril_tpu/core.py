@@ -212,7 +212,7 @@ class MythrilAnalyzer:
                 "solve_cache", "transaction_sequences", "beam_width",
                 "disable_coverage_strategy", "jobs", "no_preanalysis",
                 "no_aig_opt", "no_incremental_prep", "no_vmap_frontier",
-                "no_ragged", "trace", "inject_fault",
+                "no_ragged", "trace", "heartbeat", "inject_fault",
             ):
                 if hasattr(cmd_args, field) and getattr(cmd_args, field) is not None:
                     setattr(args, field, getattr(cmd_args, field))
@@ -228,6 +228,7 @@ class MythrilAnalyzer:
 
         from mythril_tpu.analysis.module import ModuleLoader
         from mythril_tpu.observe import TRACE_ENV, get_tracer
+        from mythril_tpu.observe import flightrec, metrics
 
         for module in ModuleLoader().get_detection_modules():
             module.reset_module()
@@ -240,10 +241,18 @@ class MythrilAnalyzer:
         from mythril_tpu.resilience import faults
 
         faults.configure_from_env(getattr(args, "inject_fault", None))
+        # always-on flight recorder: instantiate the tracer so the span
+        # ring records even with --trace unarmed (MYTHRIL_TPU_FLIGHTREC=0
+        # opts out and restores the pure no-op span path)
+        flightrec.install()
         trace_path = getattr(args, "trace", None) \
             or os.environ.get(TRACE_ENV)
         if trace_path:
             get_tracer().enable(trace_path)
+        # live heartbeat stream (--heartbeat / MYTHRIL_TPU_HEARTBEAT):
+        # periodic JSONL metrics snapshots while the run is in flight
+        heartbeat = metrics.start_heartbeat(
+            getattr(args, "heartbeat", None))
         tx_count = transaction_count or args.transaction_count
 
         # telemetry must survive the run that produced it: stats JSON and
@@ -268,6 +277,15 @@ class MythrilAnalyzer:
                     exceptions.extend(contract_exceptions)
             completed = True
         finally:
+            if not completed:
+                # the run died with work in flight: dump the flight
+                # recorder BEFORE the tracer resets, so even a
+                # --trace-unarmed crash leaves a diagnosable timeline
+                flightrec.notify_run_incomplete()
+            if heartbeat is not None:
+                # the reconciling final beat: same singleton, same
+                # finally as the stats JSON below, so the two agree
+                heartbeat.stop(final=True)
             self._dump_stats_json(stats, completed=completed)
             if trace_path:
                 tracer = get_tracer()
@@ -299,8 +317,13 @@ class MythrilAnalyzer:
         path = os.environ.get("MYTHRIL_TPU_STATS_JSON")
         if not path:
             return
+        from mythril_tpu.observe import metrics
+
         payload = stats.as_dict()
         payload["completed"] = bool(completed)
+        # self-describing artifact: schema_version + git rev + jax
+        # platform, so committed BENCH_r*.json rounds say what built them
+        payload.update(metrics.stamp())
         try:
             with open(path, "w") as fd:
                 json.dump(payload, fd)
@@ -633,6 +656,11 @@ def _corpus_worker(payload):
         module.reset_cache()
     stats = SolverStatistics()
     stats.enabled = True
+    # always-on ring in the worker too: a worker that trips a breaker or
+    # a deadline dumps its own flight-recorder artifact (per-pid files)
+    from mythril_tpu.observe import flightrec
+
+    flightrec.install()
     if getattr(args, "trace", None) or os.environ.get(TRACE_ENV):
         # collect-only: the parent writes the merged timeline
         get_tracer().enable(None)

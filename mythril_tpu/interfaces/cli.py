@@ -227,6 +227,15 @@ def add_analysis_args(parser) -> None:
                              "router, device pack/ship/kernel, CDCL "
                              "settle, cache tiers, scheduler flushes) to "
                              "PATH; env equivalent: MYTHRIL_TPU_TRACE")
+    parser.add_argument("--heartbeat", metavar="PATH", default=None,
+                        help="append periodic live-metrics snapshots "
+                             "(counters, occupancies, roofline, "
+                             "resilience events; schema_version + git "
+                             "rev + platform stamped) as JSON lines to "
+                             "PATH while the run is in flight; cadence "
+                             "via MYTHRIL_TPU_HEARTBEAT_INTERVAL "
+                             "(10 s); env equivalent: "
+                             "MYTHRIL_TPU_HEARTBEAT")
     parser.add_argument("--inject-fault", metavar="SPEC", default=None,
                         dest="inject_fault",
                         help="arm the deterministic fault-injection "

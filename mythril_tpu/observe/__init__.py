@@ -18,6 +18,18 @@ rounds can say WHERE the remaining gap is instead of just the wall:
                reconciled solver-wall decomposition whose components sum
                to the measured total. Emitted in the stats JSON under
                "roofline"; bench.py ranks the top gap stages per leg.
+  metrics.py   the LIVE plane on top of both: a typed metrics registry
+               (counter/gauge/histogram) unifying SolverStatistics
+               scalars, resilience events, and roofline figures into one
+               snapshot; a daemon-thread heartbeat appending JSONL
+               snapshots (MYTHRIL_TPU_HEARTBEAT / --heartbeat) with
+               schema_version + git rev + platform stamps; a Prometheus
+               text-exposition writer (MYTHRIL_TPU_PROM).
+  flightrec.py always-on flight recorder: a bounded ring of recent spans
+               + resilience events fed by the tracer even with
+               MYTHRIL_TPU_TRACE unarmed, auto-dumped as a post-mortem
+               artifact on breaker trips, stage deadlines, or an
+               incomplete run.
 """
 
 from mythril_tpu.observe.tracer import (  # noqa: F401 (public API)

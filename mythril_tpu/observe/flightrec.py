@@ -1,0 +1,162 @@
+"""Always-on flight recorder: a bounded ring of recent spans + resilience
+events, auto-dumped as a post-mortem artifact when something trips.
+
+The span tracer (tracer.py) answers "what happened" only when
+MYTHRIL_TPU_TRACE was armed BEFORE the run — so the wedged-device round
+that most needs a timeline is exactly the one that has none. The flight
+recorder closes that gap the way avionics do: a fixed-size ring buffer
+records the most recent spans at all times (the tracer feeds it whether
+or not full tracing is armed, inside the same <10 µs/site budget the
+tier-1 overhead guard enforces), and the ring is dumped to disk
+automatically at the first sign of trouble:
+
+  trigger                       where it fires
+  breaker_trip                  resilience/breaker.py _trip (any site)
+  deadline                      resilience/deadline.py run_with_deadline
+  run incomplete                fire_lasers' finally with completed=False
+                                (module exception / execution timeout)
+
+Each dump is a self-describing JSON artifact (metrics.stamp(): schema
+version, git rev, platform) carrying the trigger, the ring contents in
+time order, and the per-site resilience event counts at dump time. Dumps
+are capped per process (MAX_DUMPS) so a flapping stage cannot fill the
+disk; the FIRST dumps are the interesting ones anyway — the ring at the
+first trip shows what led up to it.
+
+Knobs: MYTHRIL_TPU_FLIGHTREC=0 disables the recorder entirely (span()
+reverts to the shared no-op object); MYTHRIL_TPU_FLIGHTREC_DIR picks the
+dump directory (default: the system temp dir); MYTHRIL_TPU_FLIGHTREC_CAP
+sizes the ring (default 512 events).
+"""
+
+import json
+import logging
+import os
+import tempfile
+import time
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+FLIGHTREC_ENV = "MYTHRIL_TPU_FLIGHTREC"
+DIR_ENV = "MYTHRIL_TPU_FLIGHTREC_DIR"
+CAP_ENV = "MYTHRIL_TPU_FLIGHTREC_CAP"
+DEFAULT_CAP = 512
+MAX_DUMPS = 4
+
+# resilience event names that auto-dump the ring; the lint
+# (tools/check_fault_sites.py) pins this as a subset of the registered
+# resilience event vocabulary so a renamed event cannot silently
+# disconnect the recorder
+TRIGGER_EVENTS = ("breaker_trip", "deadline")
+RUN_INCOMPLETE = "run_incomplete"
+
+_dumps_written = 0
+
+
+def enabled() -> bool:
+    return os.environ.get(FLIGHTREC_ENV, "1") != "0"
+
+
+def ring_capacity() -> int:
+    """Ring size in events; 0 disables the recorder (and restores the
+    tracer's pure no-op disabled path)."""
+    if not enabled():
+        return 0
+    try:
+        return max(int(os.environ.get(CAP_ENV, DEFAULT_CAP)), 0)
+    except ValueError:
+        return DEFAULT_CAP
+
+
+def install() -> None:
+    """Ensure the tracer singleton (and with it the ring) exists — called
+    at analyzer start (fire_lasers) and in every --jobs worker. Without
+    this, span() short-circuits on Tracer._instance is None and the ring
+    never sees a single event."""
+    from mythril_tpu.observe.tracer import get_tracer
+
+    get_tracer()
+
+
+def notify(site: str, event: str) -> Optional[str]:
+    """Resilience-event hook (called from resilience.record_event AFTER
+    the event itself entered the ring): dump the ring when `event` is a
+    registered trigger. Returns the dump path when one was written."""
+    if event not in TRIGGER_EVENTS:
+        return None
+    return _dump({"site": site, "event": event})
+
+
+def notify_run_incomplete() -> Optional[str]:
+    """fire_lasers' finally saw completed=False: the run died with work
+    in flight — dump whatever the ring holds before the tracer resets."""
+    return _dump({"site": "analyze.run", "event": RUN_INCOMPLETE})
+
+
+def dump_now(reason: str = "manual") -> Optional[str]:
+    """Operator hook: dump the ring on demand."""
+    return _dump({"site": "operator", "event": reason})
+
+
+def _dump(trigger: dict) -> Optional[str]:
+    global _dumps_written
+    # ring_capacity() folds both knobs: FLIGHTREC=0 and FLIGHTREC_CAP=0
+    # each disable the recorder — a dump with no ring is an empty file
+    if ring_capacity() <= 0 or _dumps_written >= MAX_DUMPS:
+        return None
+    # the recorder must never turn a degradation into a failure: this is
+    # called from INSIDE resilience.record_event while a breaker/deadline
+    # is mid-degradation, so nothing here may escape — including a
+    # snapshot racing another thread's first event at a new site
+    try:
+        from mythril_tpu.observe import metrics
+        from mythril_tpu.observe.tracer import Tracer
+        from mythril_tpu.smt.solver.statistics import SolverStatistics
+
+        tracer = Tracer._instance
+        events = tracer.ring_events() if tracer is not None else []
+        stats = SolverStatistics()
+        payload = metrics.stamp()
+        payload.update({
+            "ts": round(time.time(), 3),
+            "pid": os.getpid(),
+            "trigger": trigger,
+            "ring_capacity": ring_capacity(),
+            "events": events,
+            "resilience": {site: dict(site_events) for site, site_events
+                           in list(stats.resilience_events.items())},
+        })
+        directory = os.environ.get(DIR_ENV) or tempfile.gettempdir()
+        path = os.path.join(
+            directory,
+            f"mythril_tpu_flightrec_{os.getpid()}_{_dumps_written}.json")
+        os.makedirs(directory, exist_ok=True)
+        # O_EXCL: the default dir is the world-writable system temp dir
+        # and the name is predictable — never follow a pre-planted
+        # symlink (CWE-377); if the name is taken, fall back to a
+        # randomized one from mkstemp
+        try:
+            handle = os.open(path,
+                             os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o600)
+        except OSError:
+            handle, path = tempfile.mkstemp(
+                prefix=f"mythril_tpu_flightrec_{os.getpid()}_",
+                suffix=".json", dir=directory)
+        with os.fdopen(handle, "w") as fd:
+            json.dump(payload, fd)
+    except Exception as error:
+        log.warning("flight-recorder dump failed (%s)", error)
+        return None
+    _dumps_written += 1
+    log.warning(
+        "flight recorder dumped %d recent events to %s "
+        "(trigger: %s at %s)", len(events), path,
+        trigger.get("event"), trigger.get("site"))
+    return path
+
+
+def reset() -> None:
+    """Testing hook: allow MAX_DUMPS fresh dumps."""
+    global _dumps_written
+    _dumps_written = 0

@@ -1,0 +1,420 @@
+"""Live metrics plane: typed registry, heartbeat stream, Prometheus text.
+
+PR 7's spans and roofline are exit-time artifacts: a wedged round yields
+telemetry only after the run (or never). This module is the LIVE view —
+the piece the `mythril_tpu serve` daemon's `/metrics` endpoint will sit
+on, testable today:
+
+  registry     every emitted metric declared as a typed Instrument
+               (counter / gauge / histogram) with its source and whether
+               bench.py's roll-up must carry it. The registry does not
+               re-instrument the pipeline — SolverStatistics stays the
+               single write path — it ENUMERATES the live view so the
+               no-orphan-instruments lint (tools/check_stats_keys.py)
+               can prove every instrument reaches the stats JSON, the
+               heartbeat snapshot, and (where benchmarked) the bench
+               roll-up.
+  snapshot()   one point-in-time reading of everything the registry
+               names: SolverStatistics scalars (monotone counters —
+               they only grow within a run), occupancy gauges, roofline
+               attained/attainable per stage, the per-site resilience
+               events, and the run stamp.
+  heartbeat    a daemon thread appending snapshot JSONL lines every
+               MYTHRIL_TPU_HEARTBEAT_INTERVAL seconds to the
+               MYTHRIL_TPU_HEARTBEAT (or --heartbeat) path, so "what is
+               this process doing RIGHT NOW" has an answer mid-run. The
+               final beat (written from fire_lasers' finally) carries
+               final=true and reconciles with the exit stats JSON by
+               construction: both sample the same singleton.
+  prometheus   text-exposition rendering of a snapshot; with
+               MYTHRIL_TPU_PROM=<path> the heartbeat atomically rewrites
+               the exposition file each beat — point a node-exporter
+               textfile collector (or the future serve daemon) at it.
+
+Every snapshot and stats JSON is stamped with `schema_version`, the git
+revision, and the jax platform (stamp()), so committed BENCH_r*.json
+rounds and salvaged post-mortems are self-describing.
+"""
+
+import json
+import logging
+import os
+import re
+import sys
+import threading
+import time
+from typing import NamedTuple, Optional, Tuple
+
+from mythril_tpu.support.env import env_float
+
+log = logging.getLogger(__name__)
+
+# bump when the snapshot/stats-JSON envelope changes shape (keys moved or
+# re-typed — additive keys do not bump)
+SCHEMA_VERSION = 1
+
+HEARTBEAT_ENV = "MYTHRIL_TPU_HEARTBEAT"
+INTERVAL_ENV = "MYTHRIL_TPU_HEARTBEAT_INTERVAL"
+PROM_ENV = "MYTHRIL_TPU_PROM"
+DEFAULT_INTERVAL_S = 10.0
+
+
+class Instrument(NamedTuple):
+    name: str          # metric name (SolverStatistics field for source=stats)
+    kind: str          # counter | gauge | histogram
+    unit: str
+    source: str        # stats | roofline | resilience
+    benchmarked: bool  # must have a bench.py ROUTING_KEYS row
+
+
+# gauges derived from counters (SolverStatistics properties) and the
+# non-scalar histograms as_dict() already emits; counters/timers are
+# enumerated from SolverStatistics itself so a new counter is registered
+# by construction — the lint closes the loop in the other direction
+# (every instrument must reach every consumer)
+_GAUGE_NAMES = (
+    "device_occupancy", "coalesce_occupancy", "frontier_batch_occupancy")
+_HISTOGRAM_NAMES = ("prepare_suffix_hist", "interp_opcode_wall")
+_ROOFLINE_FIELDS = ("attained", "attainable", "sol_gap_s")
+
+
+def _build_registry() -> Tuple[Instrument, ...]:
+    from mythril_tpu.observe import roofline
+    from mythril_tpu.smt.solver.statistics import SolverStatistics
+
+    instruments = [
+        Instrument(name, "counter", "1", "stats", True)
+        for name in SolverStatistics._COUNTERS
+    ]
+    instruments += [
+        Instrument(name, "counter", "seconds", "stats", True)
+        for name in SolverStatistics._TIMERS
+    ]
+    instruments += [
+        Instrument(name, "gauge", "ratio", "stats", False)
+        for name in _GAUGE_NAMES
+    ]
+    instruments += [
+        Instrument(name, "histogram", "1", "stats", False)
+        for name in _HISTOGRAM_NAMES
+    ]
+    for stage in roofline.STAGES:
+        for field in _ROOFLINE_FIELDS:
+            unit = "seconds" if field == "sol_gap_s" else "per_second"
+            instruments.append(Instrument(
+                f"roofline.{stage}.{field}", "gauge", unit, "roofline",
+                False))
+    # the per-(site, event) breakdown behind the resilience_* scalars
+    instruments.append(
+        Instrument("resilience_events", "counter", "1", "resilience",
+                   False))
+    return tuple(instruments)
+
+
+REGISTRY: Tuple[Instrument, ...] = _build_registry()
+
+
+def snapshot_covers(instrument: Instrument, snap: dict) -> bool:
+    """Does this heartbeat snapshot carry the instrument? One shared
+    answer for the no-orphan-instruments lint and the tests."""
+    if instrument.source == "stats":
+        section = {"counter": "counters", "gauge": "gauges",
+                   "histogram": "histograms"}[instrument.kind]
+        return instrument.name in snap.get(section, {})
+    if instrument.source == "roofline":
+        _prefix, stage, field = instrument.name.split(".")
+        return field in snap.get("roofline", {}).get(stage, {})
+    if instrument.source == "resilience":
+        return isinstance(snap.get("resilience"), dict)
+    return False
+
+
+# -- run stamp (shared by heartbeat, stats JSON, flight recorder) -------------
+
+_git_rev_cache: Optional[str] = None
+
+
+def git_revision() -> str:
+    """Current git revision, read straight from .git (no subprocess —
+    stamps happen on telemetry paths that must never block). "unknown"
+    outside a checkout."""
+    global _git_rev_cache
+    if _git_rev_cache is not None:
+        return _git_rev_cache
+    _git_rev_cache = "unknown"
+    root = os.path.dirname(os.path.abspath(__file__))
+    for _ in range(8):
+        git_dir = os.path.join(root, ".git")
+        if os.path.isdir(git_dir):
+            _git_rev_cache = _read_git_rev(git_dir)
+            break
+        parent = os.path.dirname(root)
+        if parent == root:
+            break
+        root = parent
+    return _git_rev_cache
+
+
+def _read_git_rev(git_dir: str) -> str:
+    try:
+        with open(os.path.join(git_dir, "HEAD")) as fd:
+            head = fd.read().strip()
+        if not head.startswith("ref:"):
+            return head[:40] or "unknown"
+        ref = head.split(None, 1)[1]
+        ref_path = os.path.join(git_dir, *ref.split("/"))
+        if os.path.isfile(ref_path):
+            with open(ref_path) as fd:
+                return fd.read().strip()[:40] or "unknown"
+        packed = os.path.join(git_dir, "packed-refs")
+        if os.path.isfile(packed):
+            with open(packed) as fd:
+                for line in fd:
+                    parts = line.strip().split()
+                    if len(parts) == 2 and parts[1] == ref:
+                        return parts[0][:40]
+    except OSError:
+        pass
+    return "unknown"
+
+
+def jax_platform() -> Optional[str]:
+    """The jax backend platform, WITHOUT forcing jax (or a backend) to
+    initialize — a telemetry stamp must never be the thing that wakes a
+    wedged tunnel. None when jax was never imported; "uninitialized"
+    when jax is loaded but no backend has materialized yet."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        bridge = sys.modules.get("jax._src.xla_bridge")
+        if bridge is not None and getattr(bridge, "_backends", None):
+            return jax.default_backend()
+    except Exception:
+        pass
+    return "uninitialized"
+
+
+def stamp() -> dict:
+    """The self-description every telemetry artifact carries: heartbeat
+    snapshots, the MYTHRIL_TPU_STATS_JSON dump (so committed BENCH
+    rounds say what produced them), and flight-recorder dumps."""
+    return {
+        "schema_version": SCHEMA_VERSION,
+        "git_rev": git_revision(),
+        "platform": jax_platform(),
+    }
+
+
+# -- snapshots ----------------------------------------------------------------
+
+
+def snapshot(seq: int = 0, final: bool = False) -> dict:
+    """One live reading of every registered instrument. Counters are
+    monotone within a run (they sample the growing SolverStatistics
+    singleton); `seq` and `ts` let a reader order and gap-check the
+    stream; `final` marks the reconciling last beat."""
+    from mythril_tpu.observe import roofline
+    from mythril_tpu.resilience import registry as fault_registry
+    from mythril_tpu.smt.solver.statistics import SolverStatistics
+
+    stats = SolverStatistics()
+    counters = {name: getattr(stats, name)
+                for name in SolverStatistics._COUNTERS}
+    counters.update({name: round(getattr(stats, name), 4)
+                     for name in SolverStatistics._TIMERS})
+    gauges = {name: round(getattr(stats, name), 4)
+              for name in _GAUGE_NAMES}
+    histograms = {
+        "prepare_suffix_hist": dict(stats.prepare_suffix_hist),
+        "interp_opcode_wall": {
+            op: [count, round(seconds, 4)]
+            for op, (count, seconds) in stats.interp_opcode_wall.items()},
+    }
+    roof = roofline.build(stats)
+    roofline_view = {
+        stage: {field: row.get(field) for field in _ROOFLINE_FIELDS}
+        for stage, row in roof.get("stages", {}).items()
+    }
+    # stable zero-filled shape, like the stats JSON resilience section
+    sites = {name: dict(stats.resilience_events.get(name, {}))
+             for name in fault_registry.FAULT_SITES}
+    for site, events in stats.resilience_events.items():
+        sites.setdefault(site, dict(events))
+    snap = stamp()
+    snap.update({
+        "seq": seq,
+        "ts": round(time.time(), 3),
+        "pid": os.getpid(),
+        "final": bool(final),
+        "counters": counters,
+        "gauges": gauges,
+        "histograms": histograms,
+        "roofline": roofline_view,
+        "resilience": sites,
+    })
+    return snap
+
+
+# -- Prometheus text exposition -----------------------------------------------
+
+_PROM_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _prom_name(name: str) -> str:
+    return "mythril_tpu_" + _PROM_NAME_RE.sub("_", name)
+
+
+def _prom_escape(value) -> str:
+    return str(value).replace("\\", r"\\").replace('"', r'\"')
+
+
+def prometheus_text(snap: Optional[dict] = None) -> str:
+    """Render a snapshot in the Prometheus text exposition format — the
+    payload the serve daemon's /metrics endpoint will return, written to
+    a file today (MYTHRIL_TPU_PROM) for a textfile collector."""
+    snap = snap or snapshot()
+    lines = [
+        "# HELP mythril_tpu_build_info run stamp (constant 1)",
+        "# TYPE mythril_tpu_build_info gauge",
+        'mythril_tpu_build_info{git_rev="%s",platform="%s",'
+        'schema_version="%d"} 1' % (
+            _prom_escape(snap.get("git_rev", "unknown")),
+            _prom_escape(snap.get("platform") or "none"),
+            snap.get("schema_version", SCHEMA_VERSION)),
+    ]
+    for name, value in sorted(snap.get("counters", {}).items()):
+        prom = _prom_name(name)
+        lines.append(f"# TYPE {prom} counter")
+        lines.append(f"{prom} {value}")
+    for name, value in sorted(snap.get("gauges", {}).items()):
+        prom = _prom_name(name)
+        lines.append(f"# TYPE {prom} gauge")
+        lines.append(f"{prom} {value}")
+    for name, buckets in sorted(snap.get("histograms", {}).items()):
+        prom = _prom_name(name)
+        lines.append(f"# TYPE {prom} gauge")
+        for bucket, value in sorted(buckets.items()):
+            # interp_opcode_wall buckets are [count, seconds] pairs;
+            # suffix-hist buckets are plain counts
+            count = value[0] if isinstance(value, (list, tuple)) else value
+            lines.append(
+                f'{prom}{{bucket="{_prom_escape(bucket)}"}} {count}')
+    roof_rows = sorted(snap.get("roofline", {}).items())
+    for field in _ROOFLINE_FIELDS:
+        prom = _prom_name(f"roofline_{field}")
+        lines.append(f"# TYPE {prom} gauge")
+        for stage, row in roof_rows:
+            value = row.get(field)
+            if value is not None:
+                lines.append(
+                    f'{prom}{{stage="{_prom_escape(stage)}"}} {value}')
+    prom = _prom_name("resilience_events")
+    lines.append(f"# TYPE {prom} counter")
+    for site, events in sorted(snap.get("resilience", {}).items()):
+        for event, count in sorted(events.items()):
+            lines.append(
+                f'{prom}{{site="{_prom_escape(site)}",'
+                f'event="{_prom_escape(event)}"}} {count}')
+    return "\n".join(lines) + "\n"
+
+
+def write_prometheus(path: str, snap: Optional[dict] = None) -> bool:
+    """Atomically (re)write the exposition file — a scraper must never
+    read a torn half-write."""
+    try:
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as fd:
+            fd.write(prometheus_text(snap))
+        os.replace(tmp, path)
+        return True
+    except OSError as error:
+        log.warning("could not write prometheus exposition to %s (%s)",
+                    path, error)
+        return False
+
+
+# -- heartbeat ----------------------------------------------------------------
+
+
+class Heartbeat:
+    """Daemon-thread JSONL metrics stream. One writer per process (the
+    analyzer's fire_lasers); --jobs workers do not heartbeat — their
+    counters reach the parent through the existing stats absorb and show
+    up in the beats that follow the merge."""
+
+    # floor for any configured cadence: a zero/negative interval (env
+    # typo) must never turn the daemon into a busy loop appending
+    # snapshots continuously
+    MIN_INTERVAL_S = 0.05
+
+    def __init__(self, path: str, interval_s: Optional[float] = None,
+                 prom_path: Optional[str] = None):
+        self.path = path
+        resolved = (interval_s if interval_s and interval_s > 0
+                    else env_float(INTERVAL_ENV, DEFAULT_INTERVAL_S))
+        if resolved <= 0:
+            resolved = DEFAULT_INTERVAL_S
+        self.interval_s = max(resolved, self.MIN_INTERVAL_S)
+        self.prom_path = prom_path or os.environ.get(PROM_ENV) or None
+        self.beats = 0
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(
+            target=self._loop, name="mythril-tpu-heartbeat", daemon=True)
+
+    def start(self) -> "Heartbeat":
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.beat()
+
+    def beat(self, final: bool = False) -> Optional[dict]:
+        """Append one snapshot line (and refresh the Prometheus file).
+        Serialized under a lock so the final beat from stop() cannot
+        interleave with a timer beat. NEVER raises: a telemetry beat
+        racing a counter mutation (snapshot() walks shared dicts other
+        threads grow) must not kill the stream — and the final beat runs
+        in fire_lasers' finally, where an escape would mask the run's
+        real exception and cost the stats JSON behind it."""
+        with self._lock:
+            try:
+                snap = snapshot(seq=self.beats, final=final)
+                line = json.dumps(snap)
+                with open(self.path, "a") as fd:
+                    fd.write(line + "\n")
+            except Exception as error:
+                log.warning("heartbeat beat to %s failed (%s)",
+                            self.path, error)
+                return None
+            self.beats += 1
+            if self.prom_path:
+                write_prometheus(self.prom_path, snap)
+            return snap
+
+    def stop(self, final: bool = True) -> None:
+        """Stop the timer and write the reconciling final beat: it
+        samples the same SolverStatistics singleton the exit stats JSON
+        serializes, in the same finally, so the two artifacts agree."""
+        self._stop.set()
+        self._thread.join(timeout=self.interval_s + 5.0)
+        if final:
+            self.beat(final=True)
+
+
+def start_heartbeat(cli_path: Optional[str] = None,
+                    interval_s: Optional[float] = None
+                    ) -> Optional[Heartbeat]:
+    """Start the heartbeat if --heartbeat or MYTHRIL_TPU_HEARTBEAT names
+    a path; None (no thread at all) otherwise — the disabled path costs
+    one env read per run."""
+    path = cli_path or os.environ.get(HEARTBEAT_ENV) or None
+    if not path:
+        return None
+    heartbeat = Heartbeat(path, interval_s=interval_s)
+    heartbeat.start()
+    log.info("heartbeat metrics stream: %s every %.1fs",
+             path, heartbeat.interval_s)
+    return heartbeat

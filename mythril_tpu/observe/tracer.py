@@ -14,11 +14,15 @@ Usage (the instrumented seams throughout the pipeline):
 Design constraints, in priority order:
 
   disabled cost   tracing is OFF unless MYTHRIL_TPU_TRACE (or --trace) set
-                  a path. span() then returns ONE shared no-op object —
-                  the per-call-site cost is a module-global load, a
-                  truthiness check, and a context-manager protocol on an
-                  empty object (guarded under 2% of a stress run by the
-                  tier-1 overhead test). No thread-local, no allocation.
+                  a path. With the flight recorder ALSO off
+                  (MYTHRIL_TPU_FLIGHTREC=0), span() returns ONE shared
+                  no-op object — a module-global load, a truthiness
+                  check, and a context-manager protocol on an empty
+                  object. With the flight recorder on (the default),
+                  spans additionally land in a bounded ring
+                  (observe/flightrec.py) — a deque append under the
+                  same lock, still inside the 2%-of-stress-wall budget
+                  the tier-1 overhead test enforces (<10 µs/site).
   thread safety   completed spans append to a lock-protected list; the
                   hierarchy needs no explicit parent tracking because
                   Perfetto nests complete ("X") events by containment per
@@ -109,8 +113,24 @@ class Tracer:
             inst._events = []
             inst._lock = threading.Lock()
             inst._pid = os.getpid()
-            inst._anchor_wall_us = 0.0
-            inst._anchor_perf = 0.0
+            # flight-recorder ring: bounded capture of recent spans even
+            # with full tracing unarmed (0 capacity = recorder off).
+            # Ring spans are timestamped off a lazy anchor set here so
+            # ring events are orderable without enable() ever running.
+            try:
+                from collections import deque
+
+                from mythril_tpu.observe import flightrec
+
+                cap = flightrec.ring_capacity()
+            except Exception:
+                cap = 0
+            inst._ring = deque(maxlen=cap) if cap > 0 else None
+            inst._anchor_perf = time.perf_counter()
+            inst._anchor_wall_us = time.time() * 1e6
+            # _active is THE hot-path flag span() reads: true when either
+            # full tracing or the ring wants events
+            inst._active = inst._ring is not None
             cls._instance = inst
         return cls._instance
 
@@ -127,16 +147,37 @@ class Tracer:
         self._anchor_perf = time.perf_counter()
         self._anchor_wall_us = time.time() * 1e6
         self.enabled = True
+        self._active = True
 
     def disable(self) -> None:
         self.enabled = False
+        self._active = self._ring is not None
 
     def reset(self) -> None:
-        """Testing hook: drop collected events and disable."""
+        """Testing hook: drop collected events (and the ring) and disable
+        full tracing. The flight-recorder ring stays INSTALLED — always-on
+        means a reset starts a fresh ring, not no ring."""
         with self._lock:
             self._events = []
+            if self._ring is not None:
+                self._ring.clear()
         self.enabled = False
+        self._active = self._ring is not None
         self.path = None
+
+    # -- flight-recorder ring (observe/flightrec.py) -------------------------
+
+    def attach_ring(self, ring) -> None:
+        """Install (or replace) the bounded span ring; None detaches it
+        and restores the pure no-op disabled path."""
+        with self._lock:
+            self._ring = ring
+        self._active = self.enabled or self._ring is not None
+
+    def ring_events(self) -> List[dict]:
+        """Snapshot of the ring in time order (oldest first)."""
+        with self._lock:
+            return list(self._ring) if self._ring is not None else []
 
     # -- recording -----------------------------------------------------------
 
@@ -153,7 +194,10 @@ class Tracer:
         if attrs:
             event["args"] = {k: _jsonable(v) for k, v in attrs.items()}
         with self._lock:
-            self._events.append(event)
+            if self._ring is not None:
+                self._ring.append(event)
+            if self.enabled:
+                self._events.append(event)
 
     # -- cross-process merge (--jobs workers) --------------------------------
 
@@ -238,10 +282,11 @@ def get_tracer() -> Tracer:
 
 
 def span(name: str, cat: str = "stage", **attrs):
-    """A span context manager, or the shared no-op when tracing is off.
-    THE hot-path entry point: keep the disabled branch allocation-free."""
+    """A span context manager, or the shared no-op when neither full
+    tracing nor the flight-recorder ring wants events. THE hot-path
+    entry point: keep the inactive branch allocation-free."""
     tracer = Tracer._instance
-    if tracer is None or not tracer.enabled:
+    if tracer is None or not tracer._active:
         return NULL_SPAN
     return _Span(tracer, name, cat, attrs)
 
@@ -253,7 +298,7 @@ def traced(name: str, cat: str = "stage"):
         @wraps(func)
         def wrapped(*args, **kwargs):
             tracer = Tracer._instance
-            if tracer is None or not tracer.enabled:
+            if tracer is None or not tracer._active:
                 return func(*args, **kwargs)
             with _Span(tracer, name, cat, {}):
                 return func(*args, **kwargs)

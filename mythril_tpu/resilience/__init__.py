@@ -63,13 +63,18 @@ _fuses_blown: Dict[str, bool] = {}
 def record_event(site: str, event: str, count: int = 1) -> None:
     """Count one resilience event (SolverStatistics + stats JSON
     `resilience` section) and mark it on the span timeline as a
-    zero-width tagged event."""
+    zero-width tagged event. Flight-recorder trigger events
+    (breaker_trip / deadline) then auto-dump the ring of recent spans as
+    a post-mortem artifact — AFTER the event itself entered the ring, so
+    the dump contains its own trigger."""
+    from mythril_tpu.observe import flightrec
     from mythril_tpu.observe.tracer import span as trace_span
     from mythril_tpu.smt.solver.statistics import SolverStatistics
 
     SolverStatistics().add_resilience_event(site, event, count)
     with trace_span("resilience." + event, cat="resilience", site=site):
         pass
+    flightrec.notify(site, event)
 
 
 def note_stage_failure(site: str, hard: bool = False) -> bool:

@@ -45,6 +45,9 @@ class _Args:
         self.jobs = 1                          # corpus-parallel workers (-j)
         self.trace = None                      # --trace PATH (span tracer
         #   Perfetto export; MYTHRIL_TPU_TRACE is the env equivalent)
+        self.heartbeat = None                  # --heartbeat PATH (live JSONL
+        #   metrics stream; MYTHRIL_TPU_HEARTBEAT is the env equivalent,
+        #   MYTHRIL_TPU_HEARTBEAT_INTERVAL the cadence)
         self.inject_fault = None               # --inject-fault SPEC (chaos
         #   harness; MYTHRIL_TPU_FAULTS is the env equivalent —
         #   resilience/faults.py grammar site:kind:trigger,...)

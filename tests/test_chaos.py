@@ -303,13 +303,24 @@ def test_device_dispatch_injected_raise_falls_back_to_host():
 
 
 def test_device_dispatch_wedged_backend_trips_deadline_and_breaker(
-        monkeypatch):
+        monkeypatch, tmp_path):
     """A hang injection blocks INSIDE the dispatch (the wedged axon
     tunnel shape): the hard deadline wrapper must abandon the call, the
     breaker must open HARD, and the host CDCL settles the batch — the
-    query completes, bounded by deadline + grace, never by the hang."""
+    query completes, bounded by deadline + grace, never by the hang.
+    The always-on flight recorder must auto-dump a post-mortem artifact
+    containing the deadline + breaker_trip events, with MYTHRIL_TPU_TRACE
+    unarmed — the diagnosable-timeline guarantee for the next wedged
+    round."""
+    import glob
+
+    from mythril_tpu.observe import flightrec
+
     monkeypatch.setenv("MYTHRIL_TPU_ROUND_BUDGET", "0.4")
     monkeypatch.setenv("MYTHRIL_TPU_STAGE_GRACE", "0.3")
+    monkeypatch.setenv("MYTHRIL_TPU_FLIGHTREC_DIR", str(tmp_path))
+    flightrec.reset()
+    flightrec.install()
     from mythril_tpu.tpu import router as router_mod
 
     router_mod.reset_router()
@@ -326,6 +337,13 @@ def test_device_dispatch_wedged_backend_trips_deadline_and_breaker(
     assert recorded.get("deadline", 0) >= 1, recorded
     assert recorded.get("breaker_trip", 0) >= 1, recorded
     assert SolverStatistics().resilience_deadline_trips >= 1
+    dumps = sorted(glob.glob(str(tmp_path / "*.json")))
+    assert dumps, "the wedged backend must auto-dump the flight recorder"
+    artifact = json.load(open(dumps[-1]))
+    names = [event["name"] for event in artifact["events"]]
+    assert "resilience.deadline" in names, names
+    assert "resilience.breaker_trip" in names, names
+    assert artifact["trigger"]["site"] == "device.dispatch"
 
 
 def test_ragged_dispatch_fault_degrades_to_host_cdcl(monkeypatch):

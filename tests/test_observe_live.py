@@ -1,0 +1,470 @@
+"""Live telemetry: metrics registry + heartbeat stream, always-on flight
+recorder, and the bench trajectory observatory.
+
+Tier-1 slice of the PR-10 acceptance surface:
+
+  - the typed metrics registry covers every instrument it names (the
+    no-orphan property the check_stats_keys lint enforces end to end);
+  - heartbeat JSONL snapshots are monotone, stamped (schema_version /
+    git rev / platform), and the final beat reconciles with the exit
+    stats JSON byte-for-byte on every counter;
+  - the Prometheus text exposition is well-formed;
+  - the flight recorder captures spans with MYTHRIL_TPU_TRACE unarmed
+    and auto-dumps a post-mortem artifact on deadline/breaker_trip and
+    on an incomplete run — the artifact contains its own trigger;
+  - an abnormal --jobs worker exit leaves the parent's merged timeline
+    and metrics snapshot valid (worker-death event present, no partial-
+    span corruption);
+  - tools/bench_compare.py renders the committed BENCH_r01->r05
+    trajectory and flags the known host-rate improvement as such;
+  - bench._read_stats_json preserves (not deletes) an unparseable stats
+    dump and tags the leg instead of silently dropping evidence.
+"""
+
+import glob
+import importlib.util
+import json
+import os
+import time
+
+import pytest
+
+from mythril_tpu.observe import flightrec, metrics
+from mythril_tpu.observe.tracer import get_tracer, span
+from mythril_tpu.smt.solver.statistics import SolverStatistics
+from mythril_tpu.support.args import args
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO_ROOT, "tools", f"{name}.py"))
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(REPO_ROOT, "bench.py"))
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+@pytest.fixture(autouse=True)
+def fresh_live_telemetry_state(tmp_path, monkeypatch):
+    # dumps land in a private dir so tests never race on /tmp artifacts
+    monkeypatch.setenv(flightrec.DIR_ENV, str(tmp_path / "flightrec"))
+    stats = SolverStatistics()
+    stats.reset()
+    stats.enabled = True
+    tracer = get_tracer()
+    tracer.reset()
+    flightrec.reset()
+    yield
+    tracer.reset()
+    flightrec.reset()
+    stats.reset()
+    args.heartbeat = None
+    args.trace = None
+
+
+# -- metrics registry ---------------------------------------------------------
+
+
+def test_registry_has_no_orphan_instruments():
+    """Every registered instrument must be answerable from a snapshot —
+    the property the extended check_stats_keys lint enforces in tier-1;
+    asserted here directly so a failure names the instrument."""
+    snap = metrics.snapshot()
+    for instrument in metrics.REGISTRY:
+        assert metrics.snapshot_covers(instrument, snap), (
+            f"registered instrument {instrument.name} "
+            f"({instrument.kind}/{instrument.source}) missing from the "
+            "heartbeat snapshot")
+    # and the registry IS the whole live view of SolverStatistics
+    registered = {inst.name for inst in metrics.REGISTRY}
+    fields = set(SolverStatistics._COUNTERS) | set(
+        SolverStatistics._TIMERS)
+    assert fields <= registered
+
+
+def test_snapshot_counters_are_monotone_and_stamped():
+    stats = SolverStatistics()
+    first = metrics.snapshot(seq=0)
+    stats.add_query(0.25)
+    stats.add_cdcl_settle(clauses=10, seconds=0.01)
+    second = metrics.snapshot(seq=1)
+    for name in SolverStatistics._COUNTERS:
+        assert second["counters"][name] >= first["counters"][name]
+    assert second["counters"]["query_count"] == 1
+    assert second["counters"]["cdcl_clauses"] == 10
+    for snap in (first, second):
+        assert snap["schema_version"] == metrics.SCHEMA_VERSION
+        assert snap["git_rev"]
+        assert "platform" in snap
+        assert snap["pid"] == os.getpid()
+    assert second["seq"] > first["seq"]
+    # the whole snapshot must serialize (it IS the heartbeat line)
+    json.dumps(second)
+
+
+def test_heartbeat_stream_monotone_and_final_reconciles(tmp_path):
+    stats = SolverStatistics()
+    path = str(tmp_path / "hb.jsonl")
+    heartbeat = metrics.Heartbeat(path, interval_s=0.05).start()
+    try:
+        for _ in range(6):
+            stats.add_query(0.001)
+            time.sleep(0.05)
+    finally:
+        heartbeat.stop(final=True)
+    lines = [json.loads(line) for line in open(path)]
+    assert len(lines) >= 3
+    assert [line["seq"] for line in lines] == list(range(len(lines)))
+    for prev, cur in zip(lines, lines[1:]):
+        for name in SolverStatistics._COUNTERS:
+            assert cur["counters"][name] >= prev["counters"][name], (
+                f"counter {name} went backwards in the heartbeat stream")
+    assert lines[-1]["final"] is True
+    assert all(line["final"] is False for line in lines[:-1])
+    # final beat reconciles with the exit stats JSON: same singleton,
+    # same values for every counter and (rounded) timer
+    exit_stats = stats.as_dict()
+    for name in SolverStatistics._COUNTERS:
+        assert lines[-1]["counters"][name] == exit_stats[name]
+    for name in SolverStatistics._TIMERS:
+        assert lines[-1]["counters"][name] == pytest.approx(
+            exit_stats[name], abs=1e-4)
+
+
+def test_prometheus_exposition_well_formed(tmp_path):
+    stats = SolverStatistics()
+    stats.add_query(0.5)
+    stats.add_resilience_event("device.dispatch", "retry")
+    text = metrics.prometheus_text()
+    lines = text.splitlines()
+    assert 'mythril_tpu_build_info{' in text
+    assert "# TYPE mythril_tpu_query_count counter" in lines
+    assert "mythril_tpu_query_count 1" in lines
+    assert "# TYPE mythril_tpu_device_occupancy gauge" in lines
+    assert ('mythril_tpu_resilience_events{site="device.dispatch",'
+            'event="retry"} 1') in lines
+    # every sample line is NAME{labels} VALUE or NAME VALUE
+    for line in lines:
+        if line.startswith("#"):
+            continue
+        name, _sep, value = line.rpartition(" ")
+        assert name and value
+        float(value)
+    prom_path = str(tmp_path / "metrics.prom")
+    assert metrics.write_prometheus(prom_path)
+    assert open(prom_path).read() == metrics.prometheus_text()
+
+
+def test_heartbeat_refreshes_prometheus_file(tmp_path):
+    hb_path = str(tmp_path / "hb.jsonl")
+    prom_path = str(tmp_path / "metrics.prom")
+    heartbeat = metrics.Heartbeat(hb_path, interval_s=60.0,
+                                  prom_path=prom_path)
+    heartbeat.beat()
+    assert os.path.isfile(prom_path)
+    assert "mythril_tpu_build_info" in open(prom_path).read()
+
+
+def test_heartbeat_arg_flows_into_global_args():
+    from mythril_tpu.core import MythrilAnalyzer, MythrilDisassembler
+
+    class _Ns:
+        heartbeat = "/tmp/some_heartbeat.jsonl"
+
+    disassembler = MythrilDisassembler()
+    disassembler.load_from_bytecode("0x6000", bin_runtime=True)
+    MythrilAnalyzer(disassembler, cmd_args=_Ns())
+    assert args.heartbeat == "/tmp/some_heartbeat.jsonl"
+
+
+# -- flight recorder ----------------------------------------------------------
+
+
+def test_ring_captures_spans_with_tracing_unarmed():
+    flightrec.install()
+    tracer = get_tracer()
+    assert not tracer.enabled
+    with span("laser.exec", cat="laser"):
+        with span("solver.settle", cat="solver"):
+            pass
+    names = [event["name"] for event in tracer.ring_events()]
+    assert names == ["solver.settle", "laser.exec"]  # completion order
+    assert tracer.drain_events() == []  # the FULL buffer stayed empty
+
+
+def test_ring_is_bounded(monkeypatch):
+    from collections import deque
+
+    tracer = get_tracer()
+    old_ring = tracer._ring
+    tracer.attach_ring(deque(maxlen=8))
+    try:
+        for i in range(50):
+            with span(f"stage.{i}", cat="x"):
+                pass
+        events = tracer.ring_events()
+        assert len(events) == 8
+        assert events[-1]["name"] == "stage.49"  # newest survives
+    finally:
+        tracer.attach_ring(old_ring)
+
+
+def test_trigger_events_auto_dump_postmortem(tmp_path):
+    """deadline then breaker_trip (the wedged-backend shape): each
+    trigger dumps; the later artifact holds BOTH events plus the spans
+    that preceded them, stamped and JSON-valid."""
+    from mythril_tpu import resilience
+
+    flightrec.install()
+    with span("router.dispatch", cat="router"):
+        pass
+    resilience.record_event("device.dispatch", "deadline")
+    resilience.record_event("device.dispatch", "breaker_trip")
+    dumps = sorted(glob.glob(
+        os.path.join(os.environ[flightrec.DIR_ENV], "*.json")))
+    assert len(dumps) == 2
+    artifact = json.load(open(dumps[-1]))
+    assert artifact["trigger"] == {"site": "device.dispatch",
+                                   "event": "breaker_trip"}
+    assert artifact["schema_version"] == metrics.SCHEMA_VERSION
+    assert artifact["git_rev"]
+    names = [event["name"] for event in artifact["events"]]
+    assert "router.dispatch" in names
+    assert "resilience.deadline" in names
+    assert "resilience.breaker_trip" in names
+    assert artifact["resilience"]["device.dispatch"]["deadline"] == 1
+    assert artifact["resilience"]["device.dispatch"]["breaker_trip"] == 1
+
+
+def test_non_trigger_events_do_not_dump():
+    from mythril_tpu import resilience
+
+    flightrec.install()
+    resilience.record_event("disk.write", "retry")
+    resilience.record_event("jobs.worker", "degraded")
+    assert not glob.glob(
+        os.path.join(os.environ[flightrec.DIR_ENV], "*.json"))
+
+
+def test_dumps_capped_per_process():
+    from mythril_tpu import resilience
+
+    flightrec.install()
+    for _ in range(flightrec.MAX_DUMPS + 3):
+        resilience.record_event("device.dispatch", "breaker_trip")
+    dumps = glob.glob(
+        os.path.join(os.environ[flightrec.DIR_ENV], "*.json"))
+    assert len(dumps) == flightrec.MAX_DUMPS
+
+
+def test_flightrec_env_opt_out(monkeypatch):
+    monkeypatch.setenv(flightrec.FLIGHTREC_ENV, "0")
+    assert flightrec.ring_capacity() == 0
+    assert flightrec.notify("device.dispatch", "breaker_trip") is None
+    assert not glob.glob(
+        os.path.join(os.environ[flightrec.DIR_ENV], "*.json"))
+    # CAP=0 is the other documented off switch: no ring means no dumps
+    # either (an artifact with zero events is noise, not a post-mortem)
+    monkeypatch.setenv(flightrec.FLIGHTREC_ENV, "1")
+    monkeypatch.setenv(flightrec.CAP_ENV, "0")
+    assert flightrec.ring_capacity() == 0
+    assert flightrec.notify("device.dispatch", "breaker_trip") is None
+    assert not glob.glob(
+        os.path.join(os.environ[flightrec.DIR_ENV], "*.json"))
+
+
+def test_incomplete_run_dumps_flight_recorder(tmp_path, monkeypatch):
+    """fire_lasers' finally with completed=False must leave a
+    post-mortem artifact even with --trace unarmed — the diagnosable-
+    timeline guarantee for the next wedged round."""
+    from mythril_tpu.core import MythrilAnalyzer, MythrilDisassembler
+
+    disassembler = MythrilDisassembler()
+    disassembler.load_from_bytecode("0x600035600055600056",
+                                    bin_runtime=True)
+    analyzer = MythrilAnalyzer(disassembler, strategy="bfs")
+    monkeypatch.setattr(
+        MythrilAnalyzer, "_analyze_one_contract",
+        lambda *a, **k: (_ for _ in ()).throw(RuntimeError("boom")))
+    with pytest.raises(RuntimeError):
+        analyzer.fire_lasers(transaction_count=1)
+    dumps = glob.glob(
+        os.path.join(os.environ[flightrec.DIR_ENV], "*.json"))
+    assert len(dumps) == 1
+    artifact = json.load(open(dumps[0]))
+    assert artifact["trigger"]["event"] == flightrec.RUN_INCOMPLETE
+
+
+# -- end-to-end: heartbeat + stamp through a real analyze ---------------------
+
+
+def test_tiny_analyze_heartbeat_reconciles_with_stats_json(
+        tmp_path, monkeypatch):
+    from mythril_tpu.core import MythrilAnalyzer, MythrilDisassembler
+
+    stats_path = str(tmp_path / "stats.json")
+    hb_path = str(tmp_path / "hb.jsonl")
+    monkeypatch.setenv("MYTHRIL_TPU_STATS_JSON", stats_path)
+    monkeypatch.setenv(metrics.HEARTBEAT_ENV, hb_path)
+    monkeypatch.setenv(metrics.INTERVAL_ENV, "0.1")
+    saved_timeout = args.execution_timeout
+    args.execution_timeout = 60
+    try:
+        disassembler = MythrilDisassembler()
+        disassembler.load_from_bytecode("0x600035600055600056",
+                                        bin_runtime=True)
+        analyzer = MythrilAnalyzer(disassembler, strategy="bfs")
+        analyzer.fire_lasers(transaction_count=1)
+    finally:
+        args.execution_timeout = saved_timeout
+    payload = json.load(open(stats_path))
+    # the stats JSON is stamped (self-describing committed artifacts)
+    assert payload["schema_version"] == metrics.SCHEMA_VERSION
+    assert payload["git_rev"]
+    assert "platform" in payload
+    lines = [json.loads(line) for line in open(hb_path)]
+    assert lines, "the heartbeat never wrote a snapshot"
+    final = lines[-1]
+    assert final["final"] is True
+    for name in SolverStatistics._COUNTERS:
+        assert final["counters"][name] == payload[name], (
+            f"final heartbeat counter {name} does not reconcile with "
+            "the exit stats JSON")
+
+
+# -- abnormal --jobs worker exit (satellite: drain on worker death) -----------
+
+
+def test_worker_death_leaves_timeline_and_metrics_valid(
+        tmp_path, monkeypatch):
+    """A --jobs worker killed mid-leg (injected exit — the OOM/crash
+    shape) must leave the parent's merged trace timeline schema-valid,
+    the worker-death event in the metrics snapshot, and the snapshot
+    itself serializable — no partial-span corruption from the dead
+    worker's never-drained buffer."""
+    from mythril_tpu.core import MythrilAnalyzer, MythrilDisassembler
+
+    trace_path = str(tmp_path / "trace.json")
+    hb_path = str(tmp_path / "hb.jsonl")
+    monkeypatch.setenv("MYTHRIL_TPU_TRACE", trace_path)
+    monkeypatch.setenv(metrics.HEARTBEAT_ENV, hb_path)
+    monkeypatch.setenv(metrics.INTERVAL_ENV, "0.2")
+    saved = (args.execution_timeout, args.jobs, args.inject_fault)
+    args.execution_timeout = 60
+    args.jobs = 2
+    args.inject_fault = "jobs.worker:exit:n1"
+    try:
+        disassembler = MythrilDisassembler()
+        disassembler.load_from_bytecode("0x600035600055600056",
+                                        bin_runtime=True)
+        disassembler.load_from_bytecode("0x6000356000556001600055",
+                                        bin_runtime=True)
+        analyzer = MythrilAnalyzer(disassembler, strategy="bfs")
+        analyzer.fire_lasers(transaction_count=1)
+    finally:
+        (args.execution_timeout, args.jobs, args.inject_fault) = saved
+        from mythril_tpu.resilience import faults
+
+        faults.configure(None)
+    stats = SolverStatistics()
+    sites = stats.as_dict()["resilience"]["sites"]["jobs.worker"]
+    assert sites.get("worker_requeue", 0) >= 1 \
+        or sites.get("degraded", 0) >= 1, (
+            f"worker death left no event in the metrics plane: {sites}")
+    # merged timeline: written from the finally, schema-valid throughout
+    trace = json.load(open(trace_path))
+    x_events = [event for event in trace["traceEvents"]
+                if event["ph"] == "X"]
+    assert x_events, "the parent's own spans must survive the merge"
+    for event in x_events:
+        for field in ("name", "ts", "dur", "pid", "tid"):
+            assert field in event, f"partial span in merged trace: {event}"
+        assert event["dur"] >= 0
+    # heartbeat stream stayed valid across the worker death
+    lines = [json.loads(line) for line in open(hb_path)]
+    assert lines[-1]["final"] is True
+    json.dumps(metrics.snapshot())
+
+
+# -- bench trajectory observatory ---------------------------------------------
+
+
+def test_bench_compare_renders_committed_trajectory():
+    """The committed BENCH_r01->r05 series must render, and the known
+    host-rate 445 -> 1700 improvement must be flagged as such."""
+    bench_compare = _load_tool("bench_compare")
+    rounds = bench_compare.load_rounds(REPO_ROOT)
+    assert len(rounds) >= 5
+    table = bench_compare.render_trajectory(rounds)
+    assert "BENCH_r01" in table and "BENCH_r05" in table
+    value_row = next(line for line in table.splitlines()
+                     if line.startswith("value"))
+    assert "improvement" in value_row, (
+        "the 445 -> 1700 checks/s trajectory must be flagged as an "
+        f"improvement: {value_row}")
+    assert "445.33" in value_row and "1700.67" in value_row
+
+
+def test_bench_compare_flags_regressions_by_direction():
+    bench_compare = _load_tool("bench_compare")
+    prev = {"host_rate": 1000.0, "corpus.x.tpu_wall_s": 50.0,
+            "corpus.x.issues": 35, "zero_missed_findings": True}
+    cur = {"host_rate": 500.0, "corpus.x.tpu_wall_s": 40.0,
+           "corpus.x.issues": 34, "zero_missed_findings": False}
+    rows = {row["metric"]: row for row in bench_compare.compare(prev, cur)}
+    assert rows["host_rate"]["flag"] == "REGRESSION"  # rate halved
+    assert rows["corpus.x.tpu_wall_s"]["flag"] == "improvement"
+    assert rows["corpus.x.issues"]["flag"] == "changed"  # never routine
+    assert rows["zero_missed_findings"]["flag"] == "REGRESSION"
+    # small deltas are noise, not flags
+    quiet = bench_compare.compare({"host_rate": 1000.0},
+                                  {"host_rate": 1010.0})
+    assert quiet[0]["flag"] == ""
+
+
+def test_bench_compare_to_previous_round():
+    bench_compare = _load_tool("bench_compare")
+    current = json.load(open(
+        os.path.join(REPO_ROOT, "BENCH_r05.json")))["parsed"]
+    result = bench_compare.compare_to_previous(current, REPO_ROOT)
+    assert result["round"] == "BENCH_r05"
+    assert result["regressions"] == []  # identical payload regresses nothing
+    assert "table" in result
+
+
+# -- bench stats-dump preservation --------------------------------------------
+
+
+def test_read_stats_json_preserves_unparseable_dump(tmp_path):
+    bench = _load_bench()
+    path = str(tmp_path / "stats.json")
+    with open(path, "w") as fd:
+        fd.write('{"query_count": 3, "truncated mid-wri')
+    stats, status = bench._read_stats_json(path)
+    assert stats is None and status == "unparsed"
+    assert os.path.isfile(path), (
+        "an unparseable stats dump is evidence and must be preserved")
+    os.unlink(path)
+    # the EMPTY mkstemp-pre-created file means the child never wrote
+    # telemetry at all: that is "missing", not a torn dump, and keeping
+    # it would leak one temp file per failed leg
+    with open(path, "w"):
+        pass
+    assert bench._read_stats_json(path) == (None, "missing")
+    assert not os.path.isfile(path)
+    with open(path, "w") as fd:
+        json.dump({"query_count": 3}, fd)
+    stats, status = bench._read_stats_json(path)
+    assert status == "ok" and stats == {"query_count": 3}
+    assert not os.path.isfile(path)  # parsed dumps are consumed
+    assert bench._read_stats_json(path) == (None, "missing")
+    assert bench._read_stats_json(None) == (None, "missing")

@@ -1,0 +1,364 @@
+#!/usr/bin/env python
+"""Bench trajectory observatory: diff the committed BENCH_r*.json series.
+
+Until now the BENCH_r01-r05 trajectory was compared by hand — a
+regression between rounds (a leg's wall creeping up, a counter going
+dark, zero_missed_findings flipping) was only caught if a reviewer
+happened to stare at the right pair of JSON blobs. This tool makes the
+comparison a rendered artifact:
+
+  trajectory   one row per headline metric, one column per committed
+               round (BENCH_r01 -> rNN), with the first->last change
+               flagged as an improvement or a REGRESSION by direction
+               (rates/speedups/hits want to go up; walls, cap rejects
+               and CDCL settles want to go down).
+  delta        the latest round against its predecessor, metric by
+               metric — per-leg walls, per-leg issue counts (a changed
+               count is ALWAYS flagged: findings moving between rounds
+               is never routine), routing counters, and the per-leg top
+               speed-of-light gap from the roofline section.
+
+bench.py calls compare_to_previous() at the end of every run, so each
+fresh round prints its own regression check (stderr — stdout stays the
+single JSON line the driver parses) and embeds a compact delta summary
+in `extra.vs_previous_round`.
+
+Usage:
+    python tools/bench_compare.py [repo_root] [--threshold 0.10]
+                                  [--fail-on-regression]
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+# relative change below which a numeric delta is noise, not a flag
+DEFAULT_THRESHOLD = 0.10
+
+ROUND_GLOB = "BENCH_r*.json"
+
+# metrics worth a column in the cross-round trajectory table (flat names
+# produced by extract_metrics); everything extracted still shows in the
+# latest-vs-previous delta table
+TRAJECTORY_METRICS = (
+    "value",
+    "host_rate",
+    "analyze_wall_cpu_s",
+    "analyze_wall_tpu_s",
+    "corpus_cpu_s",
+    "corpus_tpu_s",
+    "corpus_speedup_tpu",
+    "device_hits",
+    "cap_rejects",
+    "cdcl_settles",
+    "zero_missed_findings",
+    "corpus.stress_dispatch.hex.tpu_wall_s",
+)
+
+_HIGHER_BETTER_RE = re.compile(
+    r"(rate|speedup|hits|value|resumes|occupancy|findings_equal"
+    r"|zero_missed_findings|device_solved|flips)")
+_LOWER_BETTER_RE = re.compile(
+    r"(_s$|wall|cap_rejects|cdcl_settles|sol_gap|misses|fallbacks"
+    r"|verify_rejects|degraded|deadline_trips|breaker_trips)")
+
+
+def direction(metric: str) -> int:
+    """+1 higher-is-better, -1 lower-is-better, 0 informational (never
+    flagged). issue counts are special-cased in compare(): any change is
+    flagged, neither direction is 'better'."""
+    if metric.endswith(".issues"):
+        return 0
+    if _HIGHER_BETTER_RE.search(metric):
+        return 1
+    if _LOWER_BETTER_RE.search(metric):
+        return -1
+    return 0
+
+
+# -- round loading ------------------------------------------------------------
+
+
+def load_rounds(repo_root: str) -> List[Tuple[str, dict]]:
+    """[(round name, parsed bench payload)] for every committed
+    BENCH_r*.json, in round order. Rounds whose stdout never parsed
+    (rc != 0, no `parsed`) are kept with an empty payload so the
+    trajectory shows the gap instead of silently skipping the round."""
+    rounds = []
+    for path in sorted(glob.glob(os.path.join(repo_root, ROUND_GLOB))):
+        name = os.path.splitext(os.path.basename(path))[0]
+        try:
+            with open(path) as fd:
+                blob = json.load(fd)
+        except (OSError, ValueError):
+            rounds.append((name, {}))
+            continue
+        # committed shape: {"n", "cmd", "rc", "tail", "parsed": {...}};
+        # also accept a raw bench stdout payload ({"metric": ...})
+        payload = blob.get("parsed") if isinstance(blob, dict) else None
+        if payload is None and isinstance(blob, dict) \
+                and "metric" in blob:
+            payload = blob
+        rounds.append((name, payload or {}))
+    return rounds
+
+
+def extract_metrics(payload: dict) -> Dict[str, object]:
+    """Flatten one bench payload into {metric name: value}. Absent
+    sections (older rounds carried no corpus table) simply produce no
+    keys — compare() reports them as 'new'/'gone' rather than zero."""
+    out: Dict[str, object] = {}
+    if not payload:
+        return out
+
+    def put(name, value):
+        if isinstance(value, bool):
+            out[name] = value
+            return
+        if not isinstance(value, (int, float)) or value < 0:
+            return  # negative walls are failure codes, not durations
+        if name.endswith("_s") and value == 0:
+            return  # a zero wall means "leg not measured", not "instant"
+        out[name] = value
+
+    put("value", payload.get("value"))
+    put("vs_baseline", payload.get("vs_baseline"))
+    extra = payload.get("extra") or {}
+    put("host_rate", extra.get("host_rate"))
+    # negative analyze walls are failure codes (-1 missing .. -4 failed)
+    put("analyze_wall_cpu_s", extra.get("analyze_wall_cpu_s"))
+    put("analyze_wall_tpu_s", extra.get("analyze_wall_tpu_s"))
+    put("device_solved", extra.get("device_solved"))
+    put("flips_per_sec", extra.get("flips_per_sec"))
+
+    summary = extra.get("corpus_summary") or {}
+    for key in ("corpus_cpu_s", "corpus_tpu_s", "corpus_speedup_tpu",
+                "zero_missed_findings", "device_hits", "cap_rejects",
+                "cdcl_settles", "solver_time_s", "persistent_hits",
+                "window_flushes", "batch_occupancy"):
+        put(key, summary.get(key))
+
+    for leg, row in (extra.get("corpus") or {}).items():
+        if not isinstance(row, dict):
+            continue
+        for backend in ("cpu", "tpu"):
+            cell = row.get(backend)
+            if not isinstance(cell, dict) or "fail" in cell:
+                continue
+            put(f"corpus.{leg}.{backend}_wall_s", cell.get("wall_s"))
+            if backend == "tpu":
+                put(f"corpus.{leg}.issues", cell.get("issues"))
+                gaps = cell.get("sol_gaps") or []
+                if gaps and gaps[0].get("sol_gap_s") is not None:
+                    put(f"corpus.{leg}.top_gap_s", gaps[0]["sol_gap_s"])
+                    out[f"corpus.{leg}.top_gap_stage"] = gaps[0]["stage"]
+
+    cache = extra.get("cache_warm") or {}
+    put("cache_warm.speedup", cache.get("warm_speedup"))
+    put("cache_warm.persistent_hits", cache.get("warm_persistent_hits"))
+    parallel = extra.get("corpus_parallel") or {}
+    put("corpus_parallel.speedup", parallel.get("speedup"))
+    return out
+
+
+# -- comparison ---------------------------------------------------------------
+
+
+def compare(prev: Dict[str, object], cur: Dict[str, object],
+            threshold: float = DEFAULT_THRESHOLD) -> List[dict]:
+    """Metric-by-metric delta rows, flagged by direction. Rows:
+    {metric, prev, cur, delta, ratio, flag} with flag in
+    "" | "improvement" | "REGRESSION" | "changed" | "new" | "gone"."""
+    rows = []
+    for metric in sorted(set(prev) | set(cur)):
+        if metric.endswith("top_gap_stage"):
+            continue  # label for the numeric sibling, not a metric
+        was, now = prev.get(metric), cur.get(metric)
+        row = {"metric": metric, "prev": was, "cur": now,
+               "delta": None, "ratio": None, "flag": ""}
+        if was is None or now is None:
+            row["flag"] = "new" if was is None else "gone"
+            rows.append(row)
+            continue
+        if isinstance(was, bool) or isinstance(now, bool):
+            if was != now:
+                better = direction(metric) >= 0
+                row["flag"] = ("REGRESSION" if (was and not now) == better
+                               else "improvement")
+                if direction(metric) == 0 and was != now:
+                    row["flag"] = "changed"
+            rows.append(row)
+            continue
+        delta = now - was
+        row["delta"] = round(delta, 4)
+        row["ratio"] = round(now / was, 4) if was else None
+        if metric.endswith(".issues"):
+            # findings moving between rounds is never routine
+            row["flag"] = "changed" if delta else ""
+            rows.append(row)
+            continue
+        sense = direction(metric)
+        base = max(abs(was), 1e-9)
+        if sense and abs(delta) / base > threshold:
+            improved = (delta > 0) == (sense > 0)
+            row["flag"] = "improvement" if improved else "REGRESSION"
+        rows.append(row)
+    return rows
+
+
+def flagged(rows: List[dict], flag: str) -> List[dict]:
+    return [row for row in rows if row["flag"] == flag]
+
+
+# -- rendering ----------------------------------------------------------------
+
+
+def _fmt(value) -> str:
+    if value is None:
+        return "-"
+    if isinstance(value, bool):
+        return "yes" if value else "NO"
+    if isinstance(value, float):
+        return f"{value:.2f}"
+    return str(value)
+
+
+def _render_table(table: List[tuple]) -> str:
+    """Column-aligned text rendering of (header, *rows) tuples."""
+    widths = [max(len(line[col]) for line in table)
+              for col in range(len(table[0]))]
+    lines = []
+    for i, line in enumerate(table):
+        lines.append("  ".join(
+            cell.ljust(widths[col]) for col, cell in enumerate(line)))
+        if i == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    return "\n".join(lines)
+
+
+def render_delta_table(rows: List[dict], prev_name: str,
+                       cur_name: str, only_flagged: bool = False) -> str:
+    """Aligned text table of compare() rows."""
+    body = [row for row in rows
+            if row["flag"] or not only_flagged]
+    header = ("metric", prev_name, cur_name, "delta", "flag")
+    return _render_table([header] + [
+        (row["metric"], _fmt(row["prev"]), _fmt(row["cur"]),
+         _fmt(row["delta"]), row["flag"])
+        for row in body
+    ])
+
+
+def render_trajectory(rounds: List[Tuple[str, dict]],
+                      threshold: float = DEFAULT_THRESHOLD) -> str:
+    """One row per TRAJECTORY_METRICS entry across every round, with the
+    first->last change flagged by direction — the table the ROADMAP's
+    host-rate 445 -> 1700 claim comes from, rendered instead of
+    hand-derived."""
+    extracted = [(name, extract_metrics(payload))
+                 for name, payload in rounds]
+    header = ["metric"] + [name for name, _m in extracted] + ["overall"]
+    table = [tuple(header)]
+    for metric in TRAJECTORY_METRICS:
+        series = [m.get(metric) for _name, m in extracted]
+        present = [(i, v) for i, v in enumerate(series) if v is not None]
+        overall = ""
+        if len(present) >= 2:
+            rows = compare({metric: present[0][1]},
+                           {metric: present[-1][1]}, threshold)
+            overall = rows[0]["flag"]
+            if overall and not isinstance(present[0][1], bool):
+                first, last = present[0][1], present[-1][1]
+                overall += f" ({_fmt(first)} -> {_fmt(last)})"
+        table.append(tuple([metric] + [_fmt(v) for v in series]
+                           + [overall]))
+    return _render_table(table)
+
+
+# -- bench.py integration -----------------------------------------------------
+
+
+def latest_round(repo_root: str) -> Optional[Tuple[str, dict]]:
+    rounds = load_rounds(repo_root)
+    for name, payload in reversed(rounds):
+        if payload:
+            return name, payload
+    return None
+
+
+def compare_to_previous(current_payload: dict, repo_root: str,
+                        threshold: float = DEFAULT_THRESHOLD
+                        ) -> Optional[dict]:
+    """The end-of-run hook bench.py calls: the fresh (uncommitted) round
+    against the latest committed BENCH_r*.json. Returns
+    {round, regressions, improvements, findings_changed, table} or None
+    when there is no committed round to compare against."""
+    previous = latest_round(repo_root)
+    if previous is None:
+        return None
+    prev_name, prev_payload = previous
+    rows = compare(extract_metrics(prev_payload),
+                   extract_metrics(current_payload), threshold)
+    return {
+        "round": prev_name,
+        "regressions": [
+            {"metric": r["metric"], "prev": r["prev"], "cur": r["cur"]}
+            for r in flagged(rows, "REGRESSION")],
+        "improvements": [
+            {"metric": r["metric"], "prev": r["prev"], "cur": r["cur"]}
+            for r in flagged(rows, "improvement")],
+        "findings_changed": [
+            {"metric": r["metric"], "prev": r["prev"], "cur": r["cur"]}
+            for r in flagged(rows, "changed")],
+        # a counter going DARK between rounds (reported last time, absent
+        # now) is the silent-gap failure mode this tool exists to catch —
+        # it must reach the committed round artifact, not just stderr
+        "gone_metrics": [
+            {"metric": r["metric"], "prev": r["prev"]}
+            for r in flagged(rows, "gone")],
+        "table": render_delta_table(rows, prev_name, "this-run",
+                                    only_flagged=True),
+    }
+
+
+def main(argv) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("repo_root", nargs="?", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".."))
+    parser.add_argument("--threshold", type=float,
+                        default=DEFAULT_THRESHOLD,
+                        help="relative change below which a delta is "
+                             "noise (0.10)")
+    parser.add_argument("--fail-on-regression", action="store_true",
+                        help="exit 1 when the latest round regresses "
+                             "its predecessor")
+    parsed = parser.parse_args(argv[1:])
+    root = os.path.abspath(parsed.repo_root)
+    rounds = load_rounds(root)
+    if len(rounds) < 2:
+        print(f"need at least 2 {ROUND_GLOB} rounds under {root} "
+              f"(found {len(rounds)})", file=sys.stderr)
+        return 2
+    print(f"== bench trajectory ({rounds[0][0]} -> {rounds[-1][0]}) ==")
+    print(render_trajectory(rounds, parsed.threshold))
+    prev_name, prev_payload = rounds[-2]
+    cur_name, cur_payload = rounds[-1]
+    rows = compare(extract_metrics(prev_payload),
+                   extract_metrics(cur_payload), parsed.threshold)
+    print(f"\n== {cur_name} vs {prev_name} ==")
+    print(render_delta_table(rows, prev_name, cur_name))
+    regressions = flagged(rows, "REGRESSION")
+    if regressions:
+        print(f"\n{len(regressions)} REGRESSION(s) flagged",
+              file=sys.stderr)
+        if parsed.fail_on_regression:
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -26,7 +26,14 @@ typed registry exists to kill. Five invariants:
      "resilience" section with every registered site present (the
      zero-filled stable shape the chaos suite and post-hoc diffing
      key on), and every literal record_event(site, event) in the code
-     must use a known event name.
+     must use a known event name;
+  6. the flight recorder (observe/flightrec.py) must stay WIRED to the
+     fault plane: its trigger events are known resilience events, the
+     notify seam is called from resilience.record_event (so every
+     breaker trip / deadline at a REGISTERED site can dump the ring),
+     and the run-incomplete trigger is called from core.fire_lasers'
+     finally — a recorder whose triggers drift from the registered
+     fault vocabulary silently stops producing post-mortems.
 
 Exits 1 listing the violations. Wired into tier-1 via
 tests/test_fault_sites.py.
@@ -161,6 +168,32 @@ def main(argv) -> int:
         failures.append(
             "record_event() called with event names no counter rolls up: "
             + ", ".join(unknown_events))
+
+    # 6. flight-recorder wiring: triggers inside the event vocabulary,
+    # notify seams actually called
+    from mythril_tpu.observe import flightrec
+
+    bad_triggers = sorted(
+        set(flightrec.TRIGGER_EVENTS) - set(event_counters))
+    if bad_triggers:
+        failures.append(
+            "flight-recorder trigger events are not registered "
+            "resilience events: " + ", ".join(bad_triggers))
+    resilience_init = os.path.join(
+        package_root, "resilience", "__init__.py")
+    with open(resilience_init, encoding="utf-8") as fd:
+        if "flightrec.notify(" not in fd.read():
+            failures.append(
+                "resilience.record_event does not call "
+                "flightrec.notify — breaker trips and deadlines can "
+                "never dump the flight recorder")
+    core_path = os.path.join(package_root, "core.py")
+    with open(core_path, encoding="utf-8") as fd:
+        if "notify_run_incomplete" not in fd.read():
+            failures.append(
+                "core.fire_lasers' finally does not call "
+                "flightrec.notify_run_incomplete — an incomplete run "
+                "leaves no post-mortem timeline")
 
     if failures:
         print("FAIL: the fault-site registry is not load-bearing:",

@@ -19,6 +19,20 @@ what the run actually did):
   5. bench.py's ROOFLINE_STAGES (the per-leg gap table) must mirror
      observe.roofline.STAGES — a stage without a gap row is a ceiling
      nobody sees.
+  6. NO ORPHAN INSTRUMENTS: every instrument in the live metrics
+     registry (observe/metrics.REGISTRY) must reach the heartbeat
+     snapshot (metrics.snapshot()) and the stats JSON (as_dict()), and
+     every benchmarked instrument must have a bench ROUTING_KEYS row —
+     a registered metric nobody emits is exactly the "we measure that"
+     folklore the registry exists to kill. The inverse holds too: every
+     SolverStatistics counter/timer must be a registered instrument —
+     trivially true today (the registry derives its stats instruments
+     from the same _COUNTERS/_TIMERS tuples) but pinned so a future
+     hand-maintained registry rewrite cannot silently drop fields.
+
+(The flight-recorder trigger cross-check — trigger events inside the
+resilience vocabulary, notify seams wired — lives with the fault plane
+in tools/check_fault_sites.py.)
 
 Exits 1 listing the violations. Wired into tier-1 via
 tests/test_stats_keys.py.
@@ -99,14 +113,44 @@ def main(argv) -> int:
             f"bench.py ROOFLINE_STAGES {bench_stages} does not mirror "
             f"observe.roofline.STAGES {tuple(roofline.STAGES)}")
 
+    # 6. no orphan instruments: registry -> heartbeat snapshot, stats
+    # JSON, and (where benchmarked) the bench roll-up
+    from mythril_tpu.observe import metrics
+
+    snap = metrics.snapshot()
+    for instrument in metrics.REGISTRY:
+        if not metrics.snapshot_covers(instrument, snap):
+            failures.append(
+                f"registered instrument {instrument.name!r} "
+                f"({instrument.kind}) missing from the heartbeat "
+                "snapshot (metrics.snapshot())")
+        if instrument.source == "stats" \
+                and instrument.name not in emitted:
+            failures.append(
+                f"registered instrument {instrument.name!r} missing "
+                "from the MYTHRIL_TPU_STATS_JSON emission (as_dict)")
+        if instrument.benchmarked and instrument.name not in routed:
+            failures.append(
+                f"benchmarked instrument {instrument.name!r} missing "
+                "from bench.py ROUTING_KEYS roll-up")
+    registered = {inst.name for inst in metrics.REGISTRY}
+    unregistered = sorted(set(fields) - registered)
+    if unregistered:
+        failures.append(
+            "SolverStatistics fields not registered as live-metrics "
+            "instruments (observe/metrics.REGISTRY must enumerate the "
+            "whole live view): " + ", ".join(unregistered))
+
     if failures:
         print("FAIL: SolverStatistics telemetry is not fully emitted:",
               file=sys.stderr)
         for failure in failures:
             print(f"  {failure}", file=sys.stderr)
         return 1
-    print(f"ok: {len(fields)} SolverStatistics fields, all emitted in "
-          "stats JSON and the bench roll-up")
+    print(f"ok: {len(fields)} SolverStatistics fields and "
+          f"{len(metrics.REGISTRY)} registered instruments, all emitted "
+          "in the stats JSON, the heartbeat snapshot, and the bench "
+          "roll-up")
     return 0
 
 
