@@ -31,12 +31,28 @@ ASSERTION_FAILED_TOPIC = (
 HEVM_MARKER_PREFIX = "0xcafecafecafecafecafecafecafecafecafecafe"
 
 
+def _mstore_value_blocks(value: int) -> bool:
+    """Conditional-transparency predicate for the MSTORE hook on batched
+    frontier runs: the hook acts ONLY on a concretely-written hevm
+    marker word (_hevm_marker_message — a symbolic value is already
+    inert there), so a batched MSTORE of any other concrete value — the
+    batch guarantees concreteness — may skip it. A row that DOES write
+    the marker trips this predicate and bails to the per-state
+    interpreter, where the hook fires exactly as before."""
+    return hex(value).startswith(HEVM_MARKER_PREFIX)
+
+
 class UserAssertions(DetectionModule):
     name = "user_assertions"
     swc_id = ASSERT_VIOLATION
     description = "A user-provided assertion failed."
     entry_point = EntryPoint.CALLBACK
     pre_hooks = ["REVERT", "LOG1", "MSTORE"]
+    # laser/frontier hook contract: MSTORE-bearing straight-line runs no
+    # longer cut on this module — the hook is provably inert unless the
+    # written word matches the hevm marker prefix (util.py copies this
+    # onto the bound hook as frontier_transparent_unless)
+    frontier_transparent_unless = {"MSTORE": _mstore_value_blocks}
 
     def _analyze_state(self, state):
         opcode = state.get_current_instruction().opcode

@@ -44,6 +44,15 @@ def get_detection_module_hooks(
         def hook(state, _m=module, _n=op_name, _p=prehook):
             return _m.execute(state, opcode=_n, prehook=_p)
 
+        # conditional frontier transparency: a module may declare a
+        # per-opcode value predicate under which its hook is provably
+        # inert for batched straight-line runs (laser/frontier/stepper
+        # consumes the attribute off the BOUND hook — registration and
+        # gating must see the same object)
+        predicate = getattr(module, "frontier_transparent_unless",
+                            {}).get(op_name)
+        if predicate is not None:
+            hook.frontier_transparent_unless = predicate
         return hook
 
     for module in modules:

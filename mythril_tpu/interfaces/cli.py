@@ -220,6 +220,13 @@ def add_analysis_args(parser) -> None:
                              "cube-and-conquer second pass, restoring the "
                              "level-bucketed padded dispatch; env "
                              "override: MYTHRIL_TPU_RAGGED=0|1")
+    parser.add_argument("--no-frontier-fork", action="store_true",
+                        dest="no_frontier_fork",
+                        help="disable device-side branching (batched "
+                             "forking of symbolic JUMPI inside the vmapped "
+                             "frontier, with sibling feasibility on the "
+                             "ragged SAT stream); env override: "
+                             "MYTHRIL_TPU_FRONTIER_FORK=0|1")
     parser.add_argument("--trace", metavar="PATH", default=None,
                         help="write a Chrome-trace-event / Perfetto span "
                              "timeline of the whole pipeline (analyze, "

@@ -47,6 +47,35 @@ def enabled() -> bool:
     return not getattr(args, "no_vmap_frontier", False)
 
 
+def fork_enabled() -> bool:
+    """Device-side branching: fork symbolic JUMPI batch-wise inside the
+    dense representation. MYTHRIL_TPU_FRONTIER_FORK env override first,
+    then the --no-frontier-fork flag, on top of the vmap-frontier
+    switch (a fork run IS a frontier run)."""
+    env = os.environ.get("MYTHRIL_TPU_FRONTIER_FORK", "")
+    if env in ("0", "off", "false"):
+        return False
+    if not enabled():
+        return False
+    if env in ("1", "on", "true"):
+        return True
+    from mythril_tpu.support.args import args
+
+    return not getattr(args, "no_frontier_fork", False)
+
+
+def fork_depth_cap() -> int:
+    """MYTHRIL_TPU_FRONTIER_FORK_DEPTH: rows at or past this state depth
+    take the per-state JUMPI instead of the batched fork (an operator
+    brake on fork fan-out, never a semantic change). 0 = uncapped."""
+    try:
+        return max(
+            int(os.environ.get("MYTHRIL_TPU_FRONTIER_FORK_DEPTH", "0")
+                or 0), 0)
+    except ValueError:
+        return 0
+
+
 def clear_caches() -> None:
     from mythril_tpu.laser.frontier import kernel
 

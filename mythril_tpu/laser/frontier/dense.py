@@ -131,6 +131,122 @@ def encode_frontier(states: List, run: Run,
     return dense
 
 
+def guard_tripped(run: Run, mem_log, i: int) -> bool:
+    """Row `i` wrote a value some conditionally-transparent hook is NOT
+    inert for (Run.mem_guards, e.g. the hevm assertion marker): the row
+    must bail and replay per-state so the hook fires exactly as the
+    interpreter would have fired it."""
+    for log_index, predicates in run.mem_guards:
+        value = words.int_from_limbs(mem_log[log_index][1][i])
+        if any(predicate(value) for predicate in predicates):
+            return True
+    return False
+
+
+def fork_operands(global_state, run: Run, fork_out, i: int):
+    """Row `i`'s popped (destination, condition) BitVecs for a fork run,
+    read from the UNTOUCHED pre-decode state: a window-sourced operand
+    is the original stack object (identity + annotations, exactly what
+    the interpreter's pops would see), a kernel-computed one interns the
+    kernel's word — the same constant eager folding would have left."""
+    stack = global_state.mstate.stack
+    base = len(stack) - run.touch
+
+    def operand(source, word):
+        if source >= 0:
+            return stack[base + source]
+        return symbol_factory.BitVecVal(words.int_from_limbs(word[i]), 256)
+
+    return (operand(run.fork.dest_source, fork_out[0]),
+            operand(run.fork.cond_source, fork_out[1]))
+
+
+class PendingFork:
+    """One forked row's pending path-condition table entry: the exact
+    BitVec literals the interpreter's JUMPI handler would append (same
+    term identity and annotation discipline as the opaque-slot
+    passthrough), held dense-side until the coalesced feasibility
+    verdict decides which cohort materializes — an infeasible side is
+    masked dead before it ever becomes a Python GlobalState."""
+
+    __slots__ = ("state", "dest", "branch", "negated", "take_fall",
+                 "take_jump", "fall_constrains", "jump_constrains")
+
+    def __init__(self, state, dest, branch, negated, take_fall,
+                 take_jump, fall_constrains, jump_constrains):
+        self.state = state
+        self.dest = dest
+        self.branch = branch        # cond != 0 (taken-side literal)
+        self.negated = negated      # cond == 0 (fall-through literal)
+        self.take_fall = take_fall
+        self.take_jump = take_jump
+        self.fall_constrains = fall_constrains
+        self.jump_constrains = jump_constrains
+
+    @property
+    def symbolic(self) -> bool:
+        """Both sides live — the row genuinely forks and its sibling
+        feasibility pair rides the coalesced fork bundle."""
+        return self.take_fall and self.take_jump
+
+    def side_constraints(self):
+        """(fall-side, taken-side) full constraint lists for the
+        feasibility bundle, built WITHOUT cloning the state — the base
+        list plus the pending literal, exactly the set the interpreter
+        path would hand the exec-loop fork pruner."""
+        base = list(self.state.world_state.constraints.get_all_constraints())
+        return base + [self.negated], base + [self.branch]
+
+    def materialize(self, keep_fall: bool = True,
+                    keep_jump: bool = True) -> List:
+        """Commit the surviving sides, mirroring the interpreter's
+        JUMPI handler object discipline: the fall-through side CLONES
+        the row's state (pc is already at the fall-through address from
+        decode), the taken side mutates the original in place; the
+        pending literals append to each survivor's constraints."""
+        successors = []
+        state = self.state
+        if self.take_fall and keep_fall:
+            fallthrough = state.clone()
+            fallthrough.mstate.depth += 1
+            if self.fall_constrains:
+                fallthrough.world_state.constraints.append(self.negated)
+            successors.append(fallthrough)
+        if self.take_jump and keep_jump:
+            state.mstate.pc = self.dest
+            state.mstate.depth += 1
+            if self.jump_constrains:
+                state.world_state.constraints.append(self.branch)
+            successors.append(state)
+        return successors
+
+
+def build_pending_fork(global_state, dest_obj,
+                       cond_obj) -> Optional[PendingFork]:
+    """Mirror of the interpreter's JUMPI handler term construction for
+    one decoded row, as a PENDING entry: which sides exist, which append
+    a constraint, and the literal terms themselves — bit-identical to
+    what jumpi_ would have produced. None when the destination is
+    symbolic (the per-state replay raises the exact exception)."""
+    from mythril_tpu.laser.instructions import bv, concrete_or_none
+    from mythril_tpu.smt import is_false, is_true, simplify
+
+    dest_c = concrete_or_none(dest_obj)
+    if dest_c is None:
+        return None
+    branch = simplify(cond_obj != bv(0))
+    negated = simplify(cond_obj == bv(0))
+    take_fall = not is_false(negated)
+    take_jump = (
+        dest_c in global_state.environment.code.valid_jump_destinations
+        and not is_false(branch))
+    return PendingFork(
+        global_state, dest_c, branch, negated,
+        take_fall=take_fall, take_jump=take_jump,
+        fall_constrains=not is_true(negated),
+        jump_constrains=not is_true(branch))
+
+
 def decode_state(global_state, run: Run, stack_out, mem, mem_written,
                  msize, min_gas, max_gas, i: int, mem_log=None) -> None:
     """Commit row `i` of the kernel result into `global_state`.

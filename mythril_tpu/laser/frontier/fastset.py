@@ -10,13 +10,26 @@ before any opcode with non-transparent engine hooks (detection modules,
 pruners — those must see every state individually), and before a PUSH
 with a symbolic (deploy-time-patched) operand.
 
-Deliberately OUTSIDE the fast set, with the per-state interpreter as the
-oracle: DIV/SDIV/MOD/SMOD/ADDMOD/MULMOD/EXP (bit-serial division in the
-kernel is the next promotion candidate — the interp_opcode_wall_top
-histogram measures whether it pays), SHA3/keccak (function-manager
-constraints), every environment/storage read (values are usually
-symbolic, and SLOAD/SSTORE carry detector and pruner hooks in every
-shipped configuration), and the CALL/CREATE family.
+Promoted INTO the fast set this round (per the interp_opcode_wall_top
+histogram): DIV/MOD/SDIV/SMOD as bit-serial restoring division in
+words.py, and the block-terminating symbolic JUMPI as a batched FORK —
+a run may now end in a terminal `jumpi` micro-op that pops the
+destination and condition and hands both words to the host, where the
+stepper's fork epilogue splits every live row into taken/fall-through
+cohorts with per-row pending path-condition literals
+(dense.PendingFork). Deliberately still OUTSIDE the fast set, with the
+per-state interpreter as the oracle: ADDMOD/MULMOD/EXP, SHA3/keccak
+(function-manager constraints), every environment/storage read (values
+are usually symbolic, and SLOAD/SSTORE carry detector and pruner hooks
+in every shipped configuration), and the CALL/CREATE family.
+
+Conditionally transparent hooks: an engine hook carrying a
+`frontier_transparent_unless` value predicate (user_assertions' MSTORE
+hook: inert unless the written word matches the hevm marker prefix) no
+longer cuts runs — the op enters the batch with a compile-time guard
+(Run.mem_guards) and any row whose dynamically-written value trips the
+predicate bails to the per-state interpreter, where the hook fires
+exactly as before.
 
 Compilation statically derives the run's stack shape: `touch` (how many
 entries of the caller's stack the run can read — all must be concrete and
@@ -40,6 +53,7 @@ MEM_WINDOW = 2048
 
 _BIN_OPS = {
     "ADD": "add", "SUB": "sub", "MUL": "mul",
+    "DIV": "div", "MOD": "mod", "SDIV": "sdiv", "SMOD": "smod",
     "AND": "and", "OR": "or", "XOR": "xor",
     "LT": "lt", "GT": "gt", "SLT": "slt", "SGT": "sgt", "EQ": "eq",
 }
@@ -72,6 +86,24 @@ class MicroOp:
         self.name = name
 
 
+class ForkInfo:
+    """Static description of a run's terminal batched-JUMPI fork.
+
+    `dest_source` / `cond_source` mirror Run.out_sources' encoding: the
+    original window index the popped operand passes through from (decode
+    reuses the ORIGINAL BitVec object — identical identity and
+    annotations to the interpreter's pops), or -1 for a kernel-computed
+    value (decode interns the kernel word, exactly the constant the
+    interpreter's eager folding would have left on the stack)."""
+
+    __slots__ = ("pc", "dest_source", "cond_source")
+
+    def __init__(self, pc: int, dest_source: int, cond_source: int):
+        self.pc = pc                  # the JUMPI instruction's address
+        self.dest_source = dest_source
+        self.cond_source = cond_source
+
+
 class Run:
     """A compiled straight-line run shared by every sibling state at its
     start pc within one code object."""
@@ -79,12 +111,15 @@ class Run:
     __slots__ = ("ops", "start_pc", "end_pc", "touch", "out_len",
                  "capacity", "max_height", "has_mem", "has_mload",
                  "window", "first_instr", "key", "op_names", "op_pcs",
-                 "consumed_windows", "out_sources")
+                 "consumed_windows", "out_sources", "fork", "mem_guards",
+                 "cut_at_jumpi")
 
     def __init__(self, ops: List[MicroOp], start_pc: int, end_pc: int,
                  touch: int, out_len: int, max_height: int,
                  has_mem: bool, has_mload: bool, first_instr, key,
-                 op_pcs=(), consumed_windows=None, out_sources=None):
+                 op_pcs=(), consumed_windows=None, out_sources=None,
+                 fork: Optional[ForkInfo] = None, mem_guards=(),
+                 cut_at_jumpi: bool = False):
         self.ops = ops
         self.start_pc = start_pc
         self.end_pc = end_pc
@@ -117,6 +152,17 @@ class Run:
         self.out_sources = (
             tuple([-1] * out_len) if out_sources is None
             else tuple(out_sources))
+        # terminal batched-JUMPI fork (None for straight-line runs)
+        self.fork = fork
+        # ((mem-log index, value predicates), ...) for memory stores
+        # whose engine hooks are conditionally transparent: decode bails
+        # any row whose written value trips a predicate, so the hook
+        # fires on the per-state replay exactly as it always did
+        self.mem_guards = tuple(mem_guards)
+        # the run stops right before a JUMPI it did NOT fork (feature
+        # off / no fork prefix): completed rows exit the batch dialect
+        # to the interpreter's fork handler and count as fallback exits
+        self.cut_at_jumpi = cut_at_jumpi
 
     def __len__(self):
         return len(self.ops)
@@ -236,13 +282,21 @@ def _instr_width(ins) -> int:
 
 def extract_run(summary, pc: int,
                 interior_blocked: Callable[[str], bool],
-                first_post_blocked: Callable[[str], bool]) -> Optional[Run]:
+                first_post_blocked: Callable[[str], bool],
+                guards_for: Optional[Callable] = None,
+                allow_fork: bool = False) -> Optional[Run]:
     """Compile the straight-line run starting at `pc` inside its PR-3
     basic block, or None when no batchable run (>= MIN_RUN_OPS) starts
     there. `interior_blocked(name)` must be True for opcodes carrying any
     non-transparent pre/post/instr hook; the FIRST opcode may carry pre
     hooks (the stepper fires them host-side per state) but its post hooks
-    must be transparent (`first_post_blocked`)."""
+    must be transparent (`first_post_blocked`). `guards_for(name)` may
+    return value predicates when EVERY non-transparent hook on a memory
+    store is conditionally transparent (frontier_transparent_unless) —
+    the op then enters the run guarded instead of cutting it. With
+    `allow_fork`, a run may terminate in the block's JUMPI as a batched
+    fork (its own pre/post hooks fire host-side in the fork epilogue,
+    exactly as the interpreter fires them)."""
     block = summary.cfg.block_at(pc)
     if block is None:
         return None
@@ -258,17 +312,43 @@ def extract_run(summary, pc: int,
     op_pcs: List[int] = []
     prov = _Provenance()
     has_mem = has_mload = False
+    mem_log_count = 0
+    mem_guards = []
+    fork: Optional[ForkInfo] = None
+    cut_name = None
     end_pc = pc
     for i in range(start_idx, len(block.instrs)):
         ins = block.instrs[i]
         name = ins.opcode
+        cut_name = name
+        if (allow_fork and name == "JUMPI" and ops):
+            # terminal batched fork: pop destination then condition
+            # (tracked, NOT consumed — a symbolic condition rides
+            # through opaquely; decode rebuilds the exact constraint
+            # terms the interpreter's JUMPI handler would append)
+            spec = BY_NAME["JUMPI"]
+            dest_item = prov._pop()
+            cond_item = prov._pop()
+            ops.append(MicroOp("jumpi", None, spec.gas_min, spec.gas_max,
+                               "JUMPI"))
+            op_pcs.append(ins.address)
+            end_pc = ins.address + _instr_width(ins)
+            fork = ForkInfo(ins.address, 0, 0)
+            # stash raw provenance items; converted after the loop
+            fork_items = (dest_item, cond_item)
+            break
         if not is_fast_op(name):
             break
+        guards = None
         if i == start_idx:
             if first_post_blocked(name):
                 return None
         elif interior_blocked(name):
-            break
+            guards = guards_for(name) if guards_for is not None else None
+            if guards is None or name not in ("MSTORE", "MSTORE8"):
+                # only value-writing stores are guardable: the predicate
+                # needs a dynamically-known written word to judge
+                break
         op = _compile_one(ins)
         if op is None:
             break
@@ -276,13 +356,24 @@ def extract_run(summary, pc: int,
         if op.kind == "mload":
             has_mem = has_mload = True
         elif op.kind in ("mstore", "mstore8"):
+            if guards:
+                mem_guards.append((mem_log_count, tuple(guards)))
+            mem_log_count += 1
             has_mem = True
         ops.append(op)
         op_pcs.append(ins.address)
         end_pc = ins.address + _instr_width(ins)
-    if len(ops) < MIN_RUN_OPS:
+        cut_name = None
+    min_ops = 2 if fork is not None else MIN_RUN_OPS
+    if len(ops) < min_ops:
         return None
     touch = prov.below
+    if fork is not None:
+        dest_item, cond_item = fork_items
+        fork.dest_source = (-1 if dest_item is None
+                            else touch - dest_item[1])
+        fork.cond_source = (-1 if cond_item is None
+                            else touch - cond_item[1])
     return Run(
         ops, pc, end_pc,
         touch=touch, out_len=len(prov.virtual),
@@ -293,6 +384,8 @@ def extract_run(summary, pc: int,
         consumed_windows=[touch - d for d in prov.consumed],
         out_sources=[-1 if item is None else touch - item[1]
                      for item in prov.virtual],
+        fork=fork, mem_guards=mem_guards,
+        cut_at_jumpi=(fork is None and cut_name == "JUMPI"),
         # process-unique token: the kernel's jit cache keys compiled
         # programs by it (object ids would be unsafe — the allocator
         # recycles them, and a stale hit would run the WRONG program)

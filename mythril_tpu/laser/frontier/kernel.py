@@ -84,6 +84,8 @@ def _eq(xp, a, b):
 
 _BIN_FNS = {
     "add": words.add, "sub": words.sub, "mul": words.mul,
+    "div": words.div, "mod": words.mod,
+    "sdiv": words.sdiv, "smod": words.smod,
     "and": words.bit_and, "or": words.bit_or, "xor": words.bit_xor,
     "lt": _lt, "gt": _gt, "slt": _slt, "sgt": _sgt, "eq": _eq,
 }
@@ -175,6 +177,9 @@ def _exec(bk, run: Run, slots, mem, written, msize, min_gas, max_gas,
     # byte-identical to the per-state interpreter's chain (a later
     # symbolic-index read over the chain sees the same term structure)
     mem_log = []
+    # [dest-word, condition-word] when the run terminates in a batched
+    # JUMPI fork (fastset Run.fork), else empty
+    fork_out = []
     xp = bk.xp
     for op in run.ops:
         kind = op.kind
@@ -234,6 +239,17 @@ def _exec(bk, run: Run, slots, mem, written, msize, min_gas, max_gas,
             mem, written = bk.scatter(
                 mem, written, off, value[..., 31:], ok, 1)
             mem_log.append((off, value))
+        elif kind == "jumpi":
+            # terminal fork op: pop destination then condition (the
+            # interpreter's pop order) and surface both words to the
+            # host — the stepper's fork epilogue needs the per-row
+            # concrete destination, and the condition word when the
+            # condition slot was kernel-computed. Neither operand is
+            # a "consumed" window slot: a passthrough-symbolic slot's
+            # limbs are encode-time zeros and the decode side uses the
+            # ORIGINAL BitVec object instead (fastset provenance).
+            fork_out.append(slots.pop())
+            fork_out.append(slots.pop())
         elif kind == "msize":
             slots.append(words.small_to_word(xp, msize))
         elif kind == "pc":
@@ -246,7 +262,8 @@ def _exec(bk, run: Run, slots, mem, written, msize, min_gas, max_gas,
         min_gas = min_gas + op.gas_min
         max_gas = max_gas + op.gas_max
         ok = ok & (min_gas <= gas_limit)
-    return slots, mem, written, msize, min_gas, max_gas, ok, mem_log
+    return (slots, mem, written, msize, min_gas, max_gas, ok, mem_log,
+            fork_out)
 
 
 # -- entry points ------------------------------------------------------------
@@ -256,9 +273,10 @@ def _step_numpy(run: Run, dense: DenseFrontier):
     batch = dense.batch
     bk = _NumpyBackend(batch)
     slots = [dense.stack[:, j] for j in range(run.touch)]
-    slots, mem, written, msize, min_gas, max_gas, ok, mem_log = _exec(
-        bk, run, slots, dense.mem, dense.mem_written, dense.msize,
-        dense.min_gas, dense.max_gas, dense.gas_limit, dense.live.copy())
+    slots, mem, written, msize, min_gas, max_gas, ok, mem_log, fork_out = \
+        _exec(bk, run, slots, dense.mem, dense.mem_written, dense.msize,
+              dense.min_gas, dense.max_gas, dense.gas_limit,
+              dense.live.copy())
     if slots:
         stack_out = np.stack(
             [np.broadcast_to(s, (batch, words.LIMBS)) for s in slots],
@@ -270,7 +288,9 @@ def _step_numpy(run: Run, dense: DenseFrontier):
          np.broadcast_to(value, (batch, words.LIMBS)))
         for off, value in mem_log
     ]
-    return stack_out, mem, written, msize, min_gas, max_gas, ok, mem_log
+    fork_out = [np.broadcast_to(w, (batch, words.LIMBS)) for w in fork_out]
+    return (stack_out, mem, written, msize, min_gas, max_gas, ok, mem_log,
+            fork_out)
 
 
 def _build_jax_step(run: Run):
@@ -282,9 +302,10 @@ def _build_jax_step(run: Run):
     def single(stack, mem, written, msize, min_gas, max_gas, gas_limit,
                live):
         slots = [stack[j] for j in range(run.touch)]
-        slots, mem, written, msize, min_gas, max_gas, ok, mem_log = _exec(
-            bk, run, slots, mem, written, msize, min_gas, max_gas,
-            gas_limit, live)
+        slots, mem, written, msize, min_gas, max_gas, ok, mem_log, \
+            fork_out = _exec(
+                bk, run, slots, mem, written, msize, min_gas, max_gas,
+                gas_limit, live)
         if slots:
             stack_out = jnp.stack(
                 [jnp.broadcast_to(s, (words.LIMBS,)) for s in slots])
@@ -294,6 +315,8 @@ def _build_jax_step(run: Run):
         for off, value in mem_log:
             flat_log.append(jnp.broadcast_to(off, ()))
             flat_log.append(jnp.broadcast_to(value, (words.LIMBS,)))
+        for word in fork_out:
+            flat_log.append(jnp.broadcast_to(word, (words.LIMBS,)))
         return (stack_out, mem, written, msize, min_gas, max_gas, ok,
                 *flat_log)
 
@@ -311,10 +334,13 @@ def _step_jax(run: Run, dense: DenseFrontier):
     out = step(dense.stack, dense.mem, dense.mem_written, dense.msize,
                dense.min_gas, dense.max_gas, dense.gas_limit, dense.live)
     out = [np.asarray(part) for part in out]
-    flat_log = out[7:]
+    flat = out[7:]
+    fork_words = 2 if run.fork is not None else 0
+    flat_log = flat[: len(flat) - fork_words]
     mem_log = [(flat_log[i], flat_log[i + 1])
                for i in range(0, len(flat_log), 2)]
-    return (*out[:7], mem_log)
+    fork_out = list(flat[len(flat) - fork_words:]) if fork_words else []
+    return (*out[:7], mem_log, fork_out)
 
 
 def pad_slots(n: int) -> int:
@@ -328,10 +354,12 @@ def pad_slots(n: int) -> int:
 def step_batch(run: Run, dense: DenseFrontier,
                backend: Optional[str] = None):
     """Execute `run` over the dense batch. Returns (stack_out, mem,
-    mem_written, msize, min_gas, max_gas, ok, mem_log) as numpy arrays;
-    mem_log holds one (offset, value-word) pair per MSTORE/MSTORE8 of the
-    run, in execution order. Rows with ok=False (bailed or padding) must
-    be discarded by the caller."""
+    mem_written, msize, min_gas, max_gas, ok, mem_log, fork_out) as
+    numpy arrays; mem_log holds one (offset, value-word) pair per
+    MSTORE/MSTORE8 of the run, in execution order, and fork_out holds
+    the popped [destination-word, condition-word] when the run ends in
+    a batched JUMPI fork (empty otherwise). Rows with ok=False (bailed
+    or padding) must be discarded by the caller."""
     if (backend or resolve_backend()) == "jax":
         return _step_jax(run, dense)
     return _step_numpy(run, dense)

@@ -33,6 +33,8 @@ individually.
 """
 
 import logging
+import random
+import time
 from typing import List, Optional
 
 from mythril_tpu.laser.frontier import dense, fastset, kernel
@@ -46,6 +48,23 @@ log = logging.getLogger(__name__)
 MAX_BATCH = 64
 
 _MISS = object()
+# run-cache sentinel: no batchable run at this pc, but the NEXT
+# instruction after one fast op is a JUMPI — a fork-capable site the
+# current configuration leaves to the per-state interpreter (feature
+# off / hook-gated / fork-less prefix below MIN_RUN_OPS). try_step
+# counts the handoff as a fallback exit so the branch_fusion on/off
+# legs expose exactly the exits device-side branching removes.
+_FORK_SITE = object()
+
+
+class StepResults(list):
+    """try_step's successor list, carrying the opcode the exec loop must
+    hand manage_cfg: None for straight-line runs (no CFG opcodes inside),
+    "JUMPI" when the batch forked — the successors then get the same
+    conditional-edge nodes the per-state JUMPI handler's states get.
+    Plain list at every other call site."""
+
+    op_code: Optional[str] = None
 
 
 def _span_skipped(state, pc: int) -> bool:
@@ -67,12 +86,24 @@ def _span_skipped(state, pc: int) -> bool:
 
 class FrontierStepper:
     def __init__(self, svm):
+        from mythril_tpu.laser import frontier
+
         self.svm = svm
         self.backend = kernel.resolve_backend()
         self._runs = {}          # (bytecode_hash, pc) -> Run | None
         self._blocked = {}       # opcode name -> interior-blocked bool
+        self._guards = {}        # opcode name -> predicates tuple | None
         self._engine_ok: Optional[bool] = None
-        log.debug("frontier stepper ready (backend=%s)", self.backend)
+        # device-side branching: fork symbolic JUMPI batch-wise
+        # (MYTHRIL_TPU_FRONTIER_FORK / --no-frontier-fork, on top of the
+        # vmap-frontier switch); the depth cap bounds how deep batched
+        # forking applies (0 = uncapped — the per-state path has no cap,
+        # this is an operator brake on fork fan-out)
+        self.fork_enabled = frontier.fork_enabled()
+        self.fork_depth_cap = frontier.fork_depth_cap()
+        self._fork_ok: Optional[bool] = None
+        log.debug("frontier stepper ready (backend=%s, fork=%s)",
+                  self.backend, self.fork_enabled)
 
     # -- engine / hook gates -------------------------------------------------
 
@@ -108,6 +139,48 @@ class FrontierStepper:
             self._blocked[name] = cached
         return cached
 
+    def _interior_guards(self, name: str) -> Optional[tuple]:
+        """Value predicates when EVERY non-transparent hook on `name` is
+        conditionally transparent (frontier_transparent_unless): the op
+        may enter a run guarded — a row whose written value trips a
+        predicate bails and replays per-state, where the hook fires.
+        None when any hook is unconditionally opaque."""
+        cached = self._guards.get(name, _MISS)
+        if cached is not _MISS:
+            return cached
+        svm = self.svm
+        predicates = []
+        for hook in self._hook_entries(
+                (svm.pre_hooks, svm.post_hooks,
+                 svm.instr_pre_hook, svm.instr_post_hook), name):
+            if getattr(hook, "frontier_transparent", False):
+                continue
+            predicate = getattr(hook, "frontier_transparent_unless", None)
+            if predicate is None:
+                predicates = None
+                break
+            predicates.append(predicate)
+        result = tuple(predicates) if predicates is not None else None
+        self._guards[name] = result
+        return result
+
+    def _fork_allowed(self) -> bool:
+        """Batched JUMPI forking is available: the feature switch is on
+        and JUMPI carries no non-transparent POST hooks. Pre hooks are
+        fine — the fork epilogue fires them host-side on the exact
+        pre-JUMPI state, as execute_state would — but the per-state path
+        fires post hooks on BOTH sides before the exec loop's
+        feasibility prune, and the whole point of the fused path is to
+        mask infeasible sides before they materialize."""
+        if self._fork_ok is None:
+            svm = self.svm
+            self._fork_ok = self.fork_enabled and not any(
+                not getattr(hook, "frontier_transparent", False)
+                for hook in self._hook_entries(
+                    (svm.post_hooks, svm.instr_post_hook), "JUMPI")
+            )
+        return self._fork_ok
+
     def _first_post_blocked(self, name: str) -> bool:
         svm = self.svm
         return any(
@@ -136,22 +209,44 @@ class FrontierStepper:
             if summary is not None:
                 run = fastset.extract_run(
                     summary, pc, self._interior_blocked,
-                    self._first_post_blocked)
+                    self._first_post_blocked,
+                    guards_for=self._interior_guards,
+                    allow_fork=self._fork_allowed())
+        if run is None and self._minimal_fork_site(code, pc):
+            run = _FORK_SITE
         self._runs[key] = run
         return run
 
     @staticmethod
-    def _peek_fast(code, pc: int) -> bool:
+    def _minimal_fork_site(code, pc: int) -> bool:
+        """One fast op, then a JUMPI: the minimal fork run's shape. When
+        no run compiled here the interpreter takes the branch — the
+        exit the fork feature exists to remove."""
+        index = code.index_of_address(pc)
+        if index is None or index + 1 >= len(code.instruction_list):
+            return False
+        instrs = code.instruction_list
+        return (fastset.is_fast_op(instrs[index].opcode)
+                and instrs[index + 1].opcode == "JUMPI")
+
+    def _peek_fast(self, code, pc: int) -> bool:
         index = code.index_of_address(pc)
         if index is None:
             return False
         instrs = code.instruction_list
-        if index + fastset.MIN_RUN_OPS > len(instrs):
-            return False
-        return all(
-            fastset.is_fast_op(instrs[index + k].opcode)
-            for k in range(fastset.MIN_RUN_OPS)
-        )
+        fork_ok = self._fork_allowed()
+        for k in range(fastset.MIN_RUN_OPS):
+            if index + k >= len(instrs):
+                return False
+            name = instrs[index + k].opcode
+            if fork_ok and name == "JUMPI":
+                # a fork terminal satisfies the peek with any fast
+                # prefix at all (the batched fork is the win even on
+                # short runs)
+                return k >= 1
+            if not fastset.is_fast_op(name):
+                return False
+        return True
 
     # -- sibling scheduling --------------------------------------------------
 
@@ -183,7 +278,8 @@ class FrontierStepper:
                     and state.mstate.pc == pc
                     and state.environment.code.bytecode_hash == code_hash
                     and state.mstate.depth < svm.max_depth
-                    and not _span_skipped(state, pc)
+                    and self._span_allows(state, pc, run)
+                    and self._fork_admissible(state, run)
                     and dense.state_encodable(state, run)):
                 if vet is not None and not vet(state):
                     # loop bound exceeded: dropped exactly as the
@@ -235,18 +331,58 @@ class FrontierStepper:
             # blew the fuse; the per-state interpreter owns every state
             return None
         pc = lead.mstate.pc
-        if _span_skipped(lead, pc):
-            return None
         # a pc past the code end (implicit STOP) has no instruction index
         # and falls out of _run_for's peek — the per-state path owns it
         run = self._run_for(lead.environment.code, pc)
         if run is None:
+            _span_skipped(lead, pc)  # self-clears once outside the span
             return None
-        if not dense.state_encodable(lead, run):
+        from mythril_tpu.smt.solver.statistics import SolverStatistics
+
+        if run is _FORK_SITE:
+            # fork-capable site the configuration leaves per-state: the
+            # interpreter takes this branch (one visit, one exit)
+            SolverStatistics().add_fork_site_exit()
+            return None
+        if not self._span_allows(lead, pc, run):
+            return None
+        if (not self._fork_admissible(lead, run)
+                or not dense.state_encodable(lead, run)):
+            if run.fork is not None and len(run.ops) == 2:
+                # the MINIMAL fork run refused a row: no shorter retry
+                # site exists before the JUMPI — a real dialect exit
+                SolverStatistics().add_fork_site_exit()
             lead._frontier_skip_span = (run.start_pc, run.end_pc)
             return None
         with trace_span("laser.frontier_step", cat="laser", pc=pc) as sp:
             return self._step_batch(lead, run, sp)
+
+    @staticmethod
+    def _span_allows(state, pc: int, run) -> bool:
+        """Skip-span check that does NOT let a longer run's span eat a
+        fork: a state that failed encoding at a block-head run (its
+        consumed slots held symbolic calldata) gets a span covering the
+        whole block tail, but the SHORT fork run at the terminator —
+        dispatch ladders are exactly [PUSH dest, JUMPI] after a
+        per-state EQ — may still batch. A fork run whose OWN start pc
+        set the span (a genuine fork-batch bail) still defers to the
+        per-state interpreter, so a persistently-bailing row costs one
+        batch attempt per pc, never a loop."""
+        if not _span_skipped(state, pc):
+            return True
+        if run.fork is None:
+            return False
+        span = state._frontier_skip_span
+        return span is not None and span[0] != pc
+
+    def _fork_admissible(self, state, run) -> bool:
+        """Fork-depth cap (MYTHRIL_TPU_FRONTIER_FORK_DEPTH, 0 =
+        uncapped): rows past the cap take the per-state JUMPI instead of
+        the batched fork — an operator brake on fork fan-out, never a
+        semantic change (the interpreter forks them identically)."""
+        if run.fork is None or not self.fork_depth_cap:
+            return True
+        return state.mstate.depth < self.fork_depth_cap
 
     def _step_batch(self, lead, run, sp=NULL_SPAN) -> Optional[List]:
         """The batched step itself (traced as laser.frontier_step)."""
@@ -301,8 +437,9 @@ class FrontierStepper:
             pad = (kernel.pad_slots(len(survivors))
                    if self.backend == "jax" else len(survivors))
             frame = dense.encode_frontier(survivors, run, pad_to=pad)
-            stack_out, mem, written, msize, min_gas, max_gas, ok, mem_log = \
-                kernel.step_batch(run, frame, self.backend)
+            (stack_out, mem, written, msize, min_gas, max_gas, ok,
+             mem_log, fork_out) = kernel.step_batch(run, frame,
+                                                    self.backend)
         except Exception:
             log.warning("frontier batch step failed; per-state replay for "
                         "%d state(s)", len(survivors), exc_info=True)
@@ -310,38 +447,287 @@ class FrontierStepper:
             for state in survivors:
                 state._frontier_skip_span = (run.start_pc, run.end_pc)
                 self._retract_loop_visit(state, run)
-            return survivors
+            return StepResults(survivors)
 
-        results = []
+        results = StepResults()
         completed = []
+        pending_forks = []  # dense.PendingFork per forked row, in order
+        fallback_exits = 0
         for i, state in enumerate(survivors):
-            if ok[i]:
+            row_ok = bool(ok[i])
+            if row_ok and run.mem_guards and dense.guard_tripped(
+                    run, mem_log, i):
+                # a conditionally-transparent hook is NOT inert for this
+                # row's written value (hevm marker): replay per-state so
+                # the hook fires exactly as it always did
+                row_ok = False
+            fork_operands = None
+            if row_ok and run.fork is not None:
+                from mythril_tpu.laser.instructions import concrete_or_none
+
+                # read the popped (dest, cond) objects BEFORE decode
+                # rebuilds the stack window; a symbolic destination
+                # bails the row pre-decode so the untouched original
+                # replays per-state and raises the exact
+                # InvalidJumpDestination the interpreter raises
+                fork_operands = dense.fork_operands(state, run, fork_out, i)
+                if concrete_or_none(fork_operands[0]) is None:
+                    row_ok = False
+            if row_ok:
                 dense.decode_state(state, run, stack_out, mem, written,
                                    msize, min_gas, max_gas, i,
                                    mem_log=mem_log)
                 snapshot = snapshots.get(id(state))
                 if snapshot is not None:
                     snapshot[0].states.append(snapshot[1])
-                completed.append(state)
+                if run.fork is None:
+                    completed.append(state)
+                    results.append(state)
+                else:
+                    pf = self._fork_row(state, run, fork_operands)
+                    completed.append(state)
+                    if pf is not None:
+                        pending_forks.append(pf)
+                    # pf None: PluginSkipState from a JUMPI pre hook —
+                    # the row completes with no successors, exactly as
+                    # execute_state returns [] on a skipped state
             else:
                 # replay the WHOLE run on the per-state interpreter from
                 # the untouched original state; the span flag keeps every
                 # pc of this run off the batch path for it
                 state._frontier_skip_span = (run.start_pc, run.end_pc)
                 self._retract_loop_visit(state, run)
-            results.append(state)
+                fallback_exits += 1
+                results.append(state)
 
         from mythril_tpu.smt.solver.statistics import SolverStatistics
 
-        SolverStatistics().add_frontier_step(
+        stats = SolverStatistics()
+        # completed rows of a run that CUT at an unforked JUMPI exit the
+        # batch dialect to the interpreter's fork handler: counted as
+        # dialect exits (on top of being stepped rows) so the
+        # branch_fusion on/off legs expose exactly the exits
+        # device-side branching removes
+        cut_exits = (len(completed)
+                     if run.fork is None and run.cut_at_jumpi else 0)
+        stats.add_frontier_step(
             states=len(completed), slots=pad,
-            fallback_exits=len(survivors) - len(completed))
+            fallback_exits=fallback_exits, cut_exits=cut_exits)
         sp.set(states=len(completed), slots=pad,
-               fallbacks=len(survivors) - len(completed),
-               ops=len(run.ops))
+               fallbacks=fallback_exits + cut_exits, ops=len(run.ops))
         if completed:
             for hook in svm._hooks["execute_state"]:
                 replay = getattr(hook, "frontier_batch", None)
                 if replay is not None:
                     replay(completed, run)
+        if run.fork is not None:
+            successors = self._fork_epilogue(run, pending_forks)
+            if not completed and not successors:
+                # every row bailed: pure replay, exactly the
+                # straight-line bail shape (no JUMPI executed)
+                return results
+            # bailed rows replay per-state and re-enter the worklist
+            # directly — the exec loop's new_states must carry only the
+            # fork successors (manage_cfg gives them JUMPI nodes; a
+            # bailed, untouched original must not get one)
+            if results:
+                svm.work_list.extend(results)
+            results = StepResults(successors)
+            results.op_code = "JUMPI"
         return results
+
+    # -- the batched fork (device-side branching) ---------------------------
+
+    def _fork_pre_hooks(self) -> List:
+        hooks = getattr(self, "_fork_pre", None)
+        if hooks is None:
+            svm = self.svm
+            hooks = [
+                hook for hook in self._hook_entries(
+                    (svm.pre_hooks, svm.instr_pre_hook), "JUMPI")
+                if not getattr(hook, "frontier_transparent", False)
+            ]
+            self._fork_pre = hooks
+        return hooks
+
+    def _fork_row(self, state, run, operands):
+        """Per-row JUMPI prologue, mirroring execute_state at the fork
+        instruction: reconstruct the exact pre-JUMPI machine state
+        (condition and destination back on top of the decoded stack, pc
+        at the JUMPI), record the statespace snapshot, fire the
+        non-transparent pre hooks host-side, then pop into a pending-
+        fork entry. Returns None when a hook skipped the state (no
+        successors, as execute_state returns [])."""
+        svm = self.svm
+        dest_obj, cond_obj = operands
+        mstate = state.mstate
+        mstate.pc = run.fork.pc
+        mstate.stack.append(cond_obj)
+        mstate.stack.append(dest_obj)
+        skipped = False
+        if svm.requires_statespace and state.node is not None:
+            from mythril_tpu.laser.svm import _StateSnapshot
+
+            code = state.environment.code
+            index = code.index_of_address(run.fork.pc)
+            instr = (code.instruction_list[index]
+                     if index is not None else run.first_instr)
+            state.node.states.append(_StateSnapshot(state, instr))
+        try:
+            for hook in self._fork_pre_hooks():
+                hook(state)
+        except PluginSkipState:
+            skipped = True
+        mstate.stack.pop()
+        mstate.stack.pop()
+        mstate.pc = run.end_pc
+        if skipped:
+            return None
+        return dense.build_pending_fork(state, dest_obj, cond_obj)
+
+    def _prune_decision(self) -> str:
+        """The exec loop's fork-pruning policy, verbatim (one random
+        draw per fork batch instead of per row — pruning is sound either
+        way, so the draw granularity cannot move a finding)."""
+        from mythril_tpu.support.args import args
+
+        svm = self.svm
+        pruning_factor = args.pruning_factor
+        if pruning_factor is None:
+            pruning_factor = 1.0 if svm.execution_timeout > 300 else 0.0
+        if (pruning_factor > 0.0 and svm.strategy.run_check()
+                and random.random() < pruning_factor):
+            return "solve"
+        if not svm.strategy.run_check():
+            return "park"
+        return "keep"
+
+    def _side_skippable(self, pf, run, fall: bool) -> bool:
+        """preanalysis.prune_check_skippable for one PENDING side without
+        materializing it: everything the check reads (frame stack,
+        annotations, code, pc) is shared with the row's state except the
+        pc, which is swapped in for the probe."""
+        if self.svm.preanalysis is None:
+            return False
+        from mythril_tpu import preanalysis as pre_mod
+
+        state = pf.state
+        old_pc = state.mstate.pc
+        state.mstate.pc = run.end_pc if fall else pf.dest
+        try:
+            return pre_mod.prune_check_skippable(state)
+        finally:
+            state.mstate.pc = old_pc
+
+    def _fork_epilogue(self, run, pending_forks) -> List:
+        """Split the decoded rows into taken/fall-through cohorts and
+        settle the sibling feasibility checks as ONE coalesced bundle
+        whose blasted cones ride a single ragged stream with the fork
+        literals as extra assumption roots (service/scheduler
+        solve_fork_batch → tpu/router fork lane). The host CDCL remains
+        the sole UNSAT oracle — an infeasible side is masked dead here,
+        before it ever materializes as a Python GlobalState."""
+        if not pending_forks:
+            return []
+        svm = self.svm
+        from mythril_tpu.smt.solver.statistics import SolverStatistics
+
+        stats = SolverStatistics()
+        start = time.monotonic()
+        with trace_span("frontier.fork", cat="laser",
+                        rows=len(pending_forks)) as sp:
+            symbolic = [pf for pf in pending_forks if pf.symbolic]
+            decision = self._prune_decision() if symbolic else "keep"
+            keep = {}  # id(pf) -> [keep_fall, keep_jump]
+            if decision == "solve" and symbolic:
+                bundle, pairs, sides = [], [], []
+                for pf in symbolic:
+                    fall_c, jump_c = pf.side_constraints()
+                    check_fall = not self._side_skippable(pf, run,
+                                                          fall=True)
+                    check_jump = not self._side_skippable(pf, run,
+                                                          fall=False)
+                    avoided = (not check_fall) + (not check_jump)
+                    if avoided:
+                        # skipped sides are KEPT unchecked, exactly as
+                        # the exec loop's preanalysis filter keeps them
+                        stats.add_queries_avoided(avoided)
+                    index_fall = index_jump = None
+                    if check_fall:
+                        index_fall = len(bundle)
+                        bundle.append(fall_c)
+                        sides.append((pf, 0))
+                    if check_jump:
+                        index_jump = len(bundle)
+                        bundle.append(jump_c)
+                        sides.append((pf, 1))
+                    if index_fall is not None and index_jump is not None:
+                        pairs.append((index_fall, index_jump))
+                if bundle:
+                    from mythril_tpu.service.scheduler import get_scheduler
+
+                    outcomes = get_scheduler().solve_fork_batch(
+                        bundle, pairs, crosscheck=False)
+                    pruned = 0
+                    for (pf, side), (status, _model) in zip(sides,
+                                                            outcomes):
+                        if status == "unsat":
+                            keep.setdefault(id(pf), [True, True])[side] \
+                                = False
+                            pruned += 1
+                    if pruned:
+                        stats.add_fork_pruned(pruned)
+            successors = []
+            parkable = []  # (pending fork, its materialized sides)
+            for pf in pending_forks:
+                flags = keep.get(id(pf), (True, True))
+                sides_out = pf.materialize(keep_fall=flags[0],
+                                           keep_jump=flags[1])
+                successors.extend(sides_out)
+                if pf.symbolic:
+                    parkable.append(sides_out)
+            if decision == "park" and parkable:
+                parked = {id(s) for s in self._park_successors(
+                    [side for sides in parkable for side in sides])}
+                for sides in parkable:
+                    if len(sides) == 2 and all(id(s) in parked
+                                               for s in sides):
+                        # sibling-pair token, set ONLY on sides that
+                        # actually parked: the delayed-solving drain
+                        # recovers the pairing and routes the bundle
+                        # through the fork lane (and clears the token),
+                        # so a token can never outlive its one drain —
+                        # stale tokens would re-pair long-diverged
+                        # states and corrupt the fork counters
+                        token = object()
+                        for side in sides:
+                            side._fork_pair_token = token
+                successors = [s for s in successors
+                              if id(s) not in parked]
+            if symbolic:
+                stats.add_frontier_fork(len(symbolic),
+                                        time.monotonic() - start)
+            sp.set(forked=len(symbolic), successors=len(successors))
+        return successors
+
+    def _park_successors(self, successors) -> List:
+        """Delayed-solving strategy mirror of the exec loop's pending
+        branch: forked sides failing the quick model-cache probe park in
+        the base strategy's pending_worklist (batch-solved when the
+        ready worklist drains). Returns the PARKED states."""
+        base = self.svm.strategy
+        while hasattr(base, "super_strategy"):
+            base = base.super_strategy
+        pending = getattr(base, "pending_worklist", None)
+        if pending is None:
+            return []
+        from mythril_tpu.support.model import model_cache
+
+        parked = []
+        for state in successors:
+            if model_cache.check_quick_sat(
+                    state.world_state.constraints.get_all_constraints()
+            ) is None:
+                pending.append(state)
+                parked.append(state)
+        return parked

@@ -62,13 +62,9 @@ def add(xp, a, b):
 
 
 def sub(xp, a, b):
-    cols = [a[..., i] - b[..., i] for i in range(LIMBS)]
-    for i in range(LIMBS - 1, 0, -1):
-        borrow = (cols[i] < 0).astype(a.dtype)
-        cols[i] = cols[i] + (borrow << 8)
-        cols[i - 1] = cols[i - 1] - borrow
-    cols[0] = cols[0] & 0xFF
-    return xp.stack(cols, axis=-1)
+    # one borrow-propagation implementation: the division step needs
+    # the final borrow exposed (_sub_borrow), SUB just drops it
+    return _sub_borrow(xp, a, b)[0]
 
 
 def mul(xp, a, b):
@@ -82,6 +78,137 @@ def mul(xp, a, b):
             k = i + j - (LIMBS - 1)
             cols[k] = cols[k] + ai * b[..., j]
     return _carry_canon(xp, cols)
+
+
+# -- division (bit-serial restoring long division) ---------------------------
+
+
+def _sub_borrow(xp, a, b):
+    """a - b with the final borrow exposed: (difference mod 2^256,
+    borrow-out mask). The borrow-out IS the unsigned a < b verdict, so
+    the division step gets its compare and its conditional subtract from
+    ONE limb pass."""
+    cols = [a[..., i] - b[..., i] for i in range(LIMBS)]
+    for i in range(LIMBS - 1, 0, -1):
+        borrow = (cols[i] < 0).astype(a.dtype)
+        cols[i] = cols[i] + (borrow << 8)
+        cols[i - 1] = cols[i - 1] - borrow
+    underflow = cols[0] < 0
+    cols[0] = cols[0] & 0xFF
+    return xp.stack(cols, axis=-1), underflow
+
+
+def _shift_in_bit(xp, rem, bit):
+    """rem * 2 + bit across big-endian byte limbs (one vectorized pass:
+    per-limb double is even and <= 254, so adding the carry bit — or the
+    incoming dividend bit at the LSB — cannot overflow a byte)."""
+    doubled = rem * 2
+    kept = doubled & 0xFF
+    carry = doubled >> 8
+    shifted = kept + xp.concatenate(
+        [carry[..., 1:], xp.zeros_like(carry[..., :1])], axis=-1)
+    lsb = shifted[..., 31:] + bit[..., None]
+    return xp.concatenate([shifted[..., :31], lsb], axis=-1)
+
+
+def _divmod_host(xp, a, b):
+    """Numpy (eager, concrete) divmod: the limbs ARE concrete bytes, so
+    per-row python bignum divmod is exact and ~100x cheaper than the
+    bit-serial array loop (which exists for traced backends, where
+    values are abstract)."""
+    import numpy as np
+
+    flat_a = np.asarray(a, dtype=np.int64).reshape(-1, LIMBS)
+    flat_b = np.asarray(b, dtype=np.int64).reshape(-1, LIMBS)
+    quotient = np.zeros_like(flat_a, dtype=np.int32)
+    remainder = np.zeros_like(flat_a, dtype=np.int32)
+    for i in range(flat_a.shape[0]):
+        divisor = int_from_limbs(flat_b[i])
+        if divisor == 0:
+            continue
+        q, r = divmod(int_from_limbs(flat_a[i]), divisor)
+        quotient[i] = np.frombuffer(q.to_bytes(32, "big"), dtype=np.uint8)
+        remainder[i] = np.frombuffer(r.to_bytes(32, "big"), dtype=np.uint8)
+    shape = np.shape(a)
+    return quotient.reshape(shape), remainder.reshape(shape)
+
+
+def _divmod_bitserial(xp, a, b):
+    """Traced-backend divmod: 256 bit-serial restoring-division steps as
+    a jax fori_loop (constant-size graph — an unrolled python loop would
+    trace ~25k ops per DIV and dominate compile time)."""
+    from jax import lax
+
+    abits = to_bits(xp, a)
+    qbits0 = xp.zeros_like(abits)
+    rem0 = xp.zeros_like(a)
+
+    def step(i, carry):
+        rem, qbits = carry
+        bit = lax.dynamic_index_in_dim(abits, i, axis=-1, keepdims=False)
+        rem = _shift_in_bit(xp, rem, bit)
+        diff, under = _sub_borrow(xp, rem, b)
+        rem = xp.where(under[..., None], rem, diff)
+        qbit = xp.where(under, 0, 1).astype(abits.dtype)
+        qbits = lax.dynamic_update_index_in_dim(
+            qbits, qbit[..., None], i, axis=-1)
+        return rem, qbits
+
+    rem, qbits = lax.fori_loop(0, WORD_BITS, step, (rem0, qbits0))
+    return from_bits(xp, qbits), rem
+
+
+def divmod_unsigned(xp, a, b):
+    """EVM unsigned (a // b, a % b); division by zero yields (0, 0), as
+    DIV/MOD specify. Bit-exact on either backend — the differential
+    property tests hold both paths to the per-state interpreter."""
+    if xp.__name__ == "numpy":
+        quotient, remainder = _divmod_host(xp, a, b)
+    else:
+        quotient, remainder = _divmod_bitserial(xp, a, b)
+    by_zero = is_zero_mask(xp, b)[..., None]
+    quotient = xp.where(by_zero, 0, quotient)
+    remainder = xp.where(by_zero, 0, remainder)
+    return quotient, remainder
+
+
+def _negate(xp, a):
+    """Two's-complement negation (0 - a mod 2^256)."""
+    return sub(xp, xp.zeros_like(a), a)
+
+
+def _sign_mask(xp, a):
+    return a[..., 0] >= 128
+
+
+def _abs_word(xp, a):
+    return xp.where(_sign_mask(xp, a)[..., None], _negate(xp, a), a)
+
+
+def div(xp, a, b):
+    return divmod_unsigned(xp, a, b)[0]
+
+
+def mod(xp, a, b):
+    return divmod_unsigned(xp, a, b)[1]
+
+
+def sdiv(xp, a, b):
+    """EVM SDIV: truncated signed division on two's-complement words.
+    abs-divide then negate when the signs differ; the -2^255 / -1
+    overflow case falls out correctly (abs(-2^255) = 2^255 unsigned,
+    and negating 2^255 is the identity)."""
+    quotient, _ = divmod_unsigned(xp, _abs_word(xp, a), _abs_word(xp, b))
+    negate = _sign_mask(xp, a) ^ _sign_mask(xp, b)
+    return xp.where(negate[..., None], _negate(xp, quotient), quotient)
+
+
+def smod(xp, a, b):
+    """EVM SMOD: remainder takes the DIVIDEND's sign (truncated
+    division), |b| = 0 yields 0."""
+    _, remainder = divmod_unsigned(xp, _abs_word(xp, a), _abs_word(xp, b))
+    return xp.where(_sign_mask(xp, a)[..., None],
+                    _negate(xp, remainder), remainder)
 
 
 # -- comparisons (return bool masks over the leading axes) -------------------

@@ -39,18 +39,46 @@ class DelayConstraintStrategy(BasicSearchStrategy):
             # run_round_batch device call (support/model.get_models_batch)
             batch = self.pending_worklist[:DRAIN_BATCH]
             del self.pending_worklist[:DRAIN_BATCH]
+            # batched-fork sibling pairs that landed in the same drain
+            # slice (laser/frontier dense.PendingFork tags both sides):
+            # the fork lane packs each pair's shared cone once and rides
+            # both sides on one ragged stream with the fork literals as
+            # extra assumption roots — verdict handling is identical
+            by_token = {}
+            for index, state in enumerate(batch):
+                token = getattr(state, "_fork_pair_token", None)
+                if token is not None:
+                    by_token.setdefault(id(token), []).append(index)
+                    state._fork_pair_token = None  # drained once
+            pairs = [tuple(indices) for indices in by_token.values()
+                     if len(indices) == 2]
             # engine-path pruning verdicts: wrongly pruning costs coverage,
             # not a false "safe" verdict — no UNSAT crosscheck (explicit;
             # matches get_model's non-detection default). The drained
             # bundle rides the coalescing scheduler: one window flush per
             # drain (service/scheduler.py)
-            outcomes = get_scheduler().solve_batch(
-                [s.world_state.constraints.get_all_constraints()
-                 for s in batch],
-                crosscheck=False,
-            )
-            for state, (status, _model) in zip(batch, outcomes):
+            constraint_sets = [
+                s.world_state.constraints.get_all_constraints()
+                for s in batch
+            ]
+            if pairs:
+                outcomes = get_scheduler().solve_fork_batch(
+                    constraint_sets, pairs, crosscheck=False)
+            else:
+                outcomes = get_scheduler().solve_batch(
+                    constraint_sets, crosscheck=False)
+            fork_sides = {index for pair in pairs for index in pair}
+            for index, (state, (status, _model)) in enumerate(
+                    zip(batch, outcomes)):
                 if status == "unsat":
+                    if index in fork_sides:
+                        # a batched-fork side died on a solver-confirmed
+                        # (host CDCL) verdict — the fork lane's prune
+                        from mythril_tpu.smt.solver.statistics import (
+                            SolverStatistics,
+                        )
+
+                        SolverStatistics().add_fork_pruned()
                     continue  # proven unreachable: pruned
                 # sat (model already fed to the quick-sat cache by
                 # get_models_batch) or unknown (cannot prune): revive

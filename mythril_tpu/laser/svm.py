@@ -310,13 +310,18 @@ class LaserEVM:
             try:
                 # batched frontier step first: a straight-line run over
                 # every eligible sibling as one device step. op_code None
-                # keeps manage_cfg out (runs never contain CFG opcodes).
+                # keeps manage_cfg out for straight-line runs; a batched
+                # FORK returns op_code "JUMPI" so its successors get the
+                # same conditional-edge nodes the per-state handler's
+                # states get (feasibility pruning already happened inside
+                # the stepper's fork epilogue — one coalesced bundle)
                 batched = (
                     self._frontier.try_step(global_state)
                     if self._frontier is not None else None
                 )
                 if batched is not None:
-                    new_states, op_code = batched, None
+                    new_states = batched
+                    op_code = getattr(batched, "op_code", None)
                 else:
                     new_states, op_code = self.execute_state(global_state)
             except NotImplementedError:
@@ -338,8 +343,12 @@ class LaserEVM:
             # op_code None = a batched frontier step: its multiple states
             # are SIBLINGS of one straight-line run, not fork sides — no
             # constraint changed, so feasibility solves (or pending-list
-            # parking) here would be pure waste
-            if op_code is not None and len(new_states) > 1:
+            # parking) here would be pure waste. A batched FORK
+            # (op_code "JUMPI" with batched set) already pruned and
+            # parked inside the stepper — re-solving here would double
+            # every fork's feasibility traffic
+            if batched is None and op_code is not None \
+                    and len(new_states) > 1:
                 pruning_factor = args.pruning_factor
                 if pruning_factor is None:
                     pruning_factor = 1.0 if self.execution_timeout > 300 else 0.0

@@ -122,7 +122,11 @@ def snapshot_covers(instrument: Instrument, snap: dict) -> bool:
                    "histogram": "histograms"}[instrument.kind]
         return instrument.name in snap.get(section, {})
     if instrument.source == "roofline":
-        _prefix, stage, field = instrument.name.split(".")
+        # stage names may themselves be dotted ("frontier.fork"): the
+        # field is the LAST component, the stage everything between the
+        # "roofline." prefix and it
+        stem, field = instrument.name.rsplit(".", 1)
+        stage = stem.split(".", 1)[1]
         return field in snap.get("roofline", {}).get(stage, {})
     if instrument.source == "resilience":
         return isinstance(snap.get("resilience"), dict)

@@ -48,7 +48,7 @@ log = logging.getLogger(__name__)
 # the pack/ship counterpart of that path — its ceiling comes from the
 # router micro-calibration's two-cone stream measurement
 # (ragged_bytes_s, persisted with the calibration profile).
-STAGES = ("pack", "ship", "ragged", "kernel", "settle")
+STAGES = ("pack", "ship", "ragged", "kernel", "settle", "frontier.fork")
 
 _UNITS = {
     "pack": "bytes/s",
@@ -56,6 +56,12 @@ _UNITS = {
     "ragged": "bytes/s",
     "kernel": "cells/s",
     "settle": "clauses/s",
+    # device-side branching: rows forked batch-wise at symbolic JUMPI
+    # per second of fork-epilogue wall (pending-condition rebuild +
+    # coalesced feasibility + cohort materialization). No calibrated
+    # ceiling yet — the stage reports attained only, and top_gaps ranks
+    # it strictly last (gap unknown is not gap zero)
+    "frontier.fork": "rows/s",
 }
 
 
@@ -144,6 +150,11 @@ def _build(stats) -> dict:
             stats.settle_wall,
             rates.get("settle_clauses_s"),
             _UNITS["settle"]),
+        "frontier.fork": _stage_row(
+            stats.frontier_fork_rows,
+            stats.frontier_fork_wall,
+            None,
+            _UNITS["frontier.fork"]),
     }
 
     total = stats.solver_time

@@ -155,6 +155,30 @@ class CoalescingScheduler:
             handles.append(handle)
         return [handle.result() for handle in handles]
 
+    def solve_fork_batch(self, constraint_sets, pairs,
+                         crosscheck: Optional[bool] = False) -> List:
+        """Fork-bundle seam (laser/frontier/stepper.py fork epilogue):
+        the taken/fall-through sibling feasibility checks of ONE batched
+        JUMPI fork, handed to get_models_batch as a single coalesced
+        bundle with `pairs` — (i, j) index pairs marking two sides of
+        the same row — forwarded to the router's fork lane, which packs
+        a pair's shared cone once and rides both sides on one ragged
+        stream with the fork literals as extra assumption roots. Any
+        already-buffered traffic flushes first so the pair indices stay
+        aligned with the bundle."""
+        if not self.enabled:
+            from mythril_tpu.support.model import get_models_batch
+
+            return get_models_batch(constraint_sets, crosscheck=crosscheck,
+                                    fork_pairs=pairs)
+        self.flush()
+        from mythril_tpu.smt.solver.statistics import SolverStatistics
+        from mythril_tpu.support.model import get_models_batch
+
+        SolverStatistics().add_window_flush(len(constraint_sets))
+        return get_models_batch(constraint_sets, crosscheck=crosscheck,
+                                fork_pairs=pairs)
+
     def flush(self) -> None:
         """Solve everything buffered: one _solve_group per distinct
         crosscheck flag (submission order preserved per group; the group

@@ -93,8 +93,26 @@ class SolverStatistics:
         # interpreter mid-run
         "frontier_vmap_steps",
         "frontier_states_stepped",
+        # states handed back to the per-state interpreter at a
+        # batch-capable site: mid-run bails (frontier_batch_bails, a
+        # subset) plus rows whose run CUT at an unforked JUMPI and
+        # per-state handoffs at fork-capable sites the configuration
+        # left unbatched — the branch_fusion on/off comparator
         "frontier_fallback_exits",
+        # mid-run bails only (slot-occupying rows that exited the batch
+        # before completing) — the occupancy numerator's second half
+        "frontier_batch_bails",
         "frontier_batch_slots",
+        # device-side branching (laser/frontier/stepper.py): batched
+        # symbolic-JUMPI forks — fork events (batch steps that forked),
+        # the rows that split into taken/fall-through cohorts, sides
+        # masked dead after a solver-confirmed (host-CDCL) infeasibility
+        # verdict, and ragged stream launches that carried fork-side
+        # feasibility cones (tpu/router.py fork lane)
+        "frontier_forks",
+        "frontier_fork_rows",
+        "frontier_fork_infeasible_pruned",
+        "fork_stream_dispatches",
         # fault containment (mythril_tpu/resilience/): every degradation
         # a registered fault site took — retries with jittered backoff,
         # per-stage breaker trips and half-open re-probes, quarantined
@@ -152,6 +170,13 @@ class SolverStatistics:
         # (sat_backend._crosscheck_unsat) — soundness-net overhead,
         # reported separately so it can never masquerade as settle cost
         "crosscheck_wall",
+        # wall spent in the frontier's batched fork epilogue (pending-
+        # condition rebuild, sibling feasibility bundle, cohort
+        # materialization) — busy denominator of the frontier.fork
+        # roofline stage (work = frontier_fork_rows). Feasibility solver
+        # seconds are INCLUDED: the fused step→solve round trip is
+        # exactly what this stage times
+        "frontier_fork_wall",
     )
 
     def __new__(cls):
@@ -449,17 +474,57 @@ class SolverStatistics:
             self.strash_xquery_merges += count
 
     def add_frontier_step(self, states: int, slots: int,
-                          fallback_exits: int) -> None:
+                          fallback_exits: int,
+                          cut_exits: int = 0) -> None:
         """One batched frontier step: `states` sibling machine states
         executed a straight-line opcode run as one device step, padded to
         `slots` batch slots (the jit shape bucket); `fallback_exits` of
         the batch bailed mid-run back to the per-state interpreter
-        (symbolic operand materialized, memory-window overflow, gas)."""
+        (symbolic operand materialized, memory-window overflow, gas,
+        tripped value guard); `cut_exits` completed rows whose run cut
+        at an unforked JUMPI — they leave the batch dialect for the
+        interpreter's fork handler (counted in fallback_exits but not
+        in the occupancy numerator: unlike bails they also count as
+        stepped rows)."""
         if self.enabled:
             self.frontier_vmap_steps += 1
             self.frontier_states_stepped += states
             self.frontier_batch_slots += slots
-            self.frontier_fallback_exits += fallback_exits
+            self.frontier_batch_bails += fallback_exits
+            self.frontier_fallback_exits += fallback_exits + cut_exits
+
+    def add_fork_site_exit(self, count: int = 1) -> None:
+        """A state handed to the per-state interpreter at a
+        fork-capable JUMPI site the configuration left unbatched
+        (feature off, hook-gated, depth-capped, or unencodable at the
+        minimal fork run) — the off-leg side of the branch_fusion
+        fallback-exit comparison."""
+        if self.enabled:
+            self.frontier_fallback_exits += count
+
+    def add_frontier_fork(self, rows: int, seconds: float = 0.0) -> None:
+        """One batched fork event: `rows` live sibling rows reached a
+        symbolic JUMPI and split batch-wise into taken/fall-through
+        cohorts inside the dense representation; `seconds` is the fork
+        epilogue wall (pending-condition rebuild + coalesced feasibility
+        + cohort materialization)."""
+        if self.enabled:
+            self.frontier_forks += 1
+            self.frontier_fork_rows += rows
+            self.frontier_fork_wall += seconds
+
+    def add_fork_pruned(self, count: int = 1) -> None:
+        """Fork sides masked dead after a solver-confirmed (host-CDCL
+        UNSAT oracle) infeasibility verdict — never device-candidate
+        evidence — before the side materialized as a GlobalState."""
+        if self.enabled:
+            self.frontier_fork_infeasible_pruned += count
+
+    def add_fork_stream_dispatch(self, count: int = 1) -> None:
+        """One ragged stream launch that carried fork-side feasibility
+        cones (shared-cone extra-root pairs or per-side cones alike)."""
+        if self.enabled:
+            self.fork_stream_dispatches += count
 
     def add_resilience_event(self, site: str, event: str,
                              count: int = 1) -> None:
@@ -494,11 +559,13 @@ class SolverStatistics:
     @property
     def frontier_batch_occupancy(self) -> float:
         """Mean fraction of padded frontier batch slots holding live
-        sibling states (states_stepped + fallback_exits are all live on
-        entry; padding to the jit shape bucket is the waste)."""
+        sibling states (states_stepped + mid-run bails are all live on
+        entry; padding to the jit shape bucket is the waste). Dialect
+        exits that never occupied a slot (fork-site handoffs) are
+        deliberately excluded."""
         if not self.frontier_batch_slots:
             return 0.0
-        return (self.frontier_states_stepped + self.frontier_fallback_exits) \
+        return (self.frontier_states_stepped + self.frontier_batch_bails) \
             / self.frontier_batch_slots
 
     @property

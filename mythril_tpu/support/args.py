@@ -40,6 +40,9 @@ class _Args:
         #   (MYTHRIL_TPU_VMAP_FRONTIER=0/1 overrides; laser.frontier)
         self.no_ragged = False                 # --no-ragged
         #   (MYTHRIL_TPU_RAGGED=0/1 overrides; tpu.router.ragged_enabled)
+        self.no_frontier_fork = False          # --no-frontier-fork
+        #   (MYTHRIL_TPU_FRONTIER_FORK=0/1 overrides; laser.frontier
+        #   fork_enabled — device-side branching at symbolic JUMPI)
         self.beam_width = 8                    # --beam-search WIDTH
         self.transaction_sequences = None      # e.g. "[[0xa9059cbb],[-1]]"
         self.jobs = 1                          # corpus-parallel workers (-j)

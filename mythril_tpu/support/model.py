@@ -458,6 +458,7 @@ def get_models_batch(
     enforce_execution_time: bool = True,
     solver_timeout: Optional[int] = None,
     crosscheck: Optional[bool] = None,
+    fork_pairs=None,
 ) -> List:
     """Batched multi-query solve — THE production device fan-out.
 
@@ -476,12 +477,20 @@ def get_models_batch(
     `crosscheck` requests the permuted-instance UNSAT second opinion on
     the CDCL settling pass (None = follow the ambient detection context,
     same policy as get_model).
+
+    `fork_pairs` — (i, j) index pairs into `constraint_sets` marking the
+    taken/fall-through sides of one batched JUMPI fork (the frontier's
+    fork bundle): forwarded to the router so a pair whose blasted cones
+    still share their base roots packs ONCE and rides one ragged stream
+    with the fork literals as extra assumption roots. Purely a routing
+    hint — verdicts, caching, and the CDCL UNSAT oracle are untouched.
     """
     with trace_span("solver.batch", cat="solver",
                     queries=len(constraint_sets)):
         return _get_models_batch_impl(constraint_sets,
                                       enforce_execution_time,
-                                      solver_timeout, crosscheck)
+                                      solver_timeout, crosscheck,
+                                      fork_pairs=fork_pairs)
 
 
 def _get_models_batch_impl(
@@ -489,6 +498,7 @@ def _get_models_batch_impl(
     enforce_execution_time: bool = True,
     solver_timeout: Optional[int] = None,
     crosscheck: Optional[bool] = None,
+    fork_pairs=None,
 ) -> List:
     from mythril_tpu.smt.solver.frontend import Solver
 
@@ -589,7 +599,19 @@ def _get_models_batch_impl(
                 (p.num_vars, p.clauses, p.aig_roots)
                 for _, _, _, _, p in eligible
             ]
-            bits_list = get_router().dispatch(problems, timeout_s, stats)
+            # remap fork pairs onto the eligible-problem axis: a pair
+            # survives only when BOTH sides reached the router (host
+            # tiers may have settled one side already)
+            eligible_pairs = None
+            if fork_pairs:
+                position = {entry[0]: pos
+                            for pos, entry in enumerate(eligible)}
+                eligible_pairs = [
+                    (position[i], position[j]) for i, j in fork_pairs
+                    if i in position and j in position
+                ] or None
+            bits_list = get_router().dispatch(problems, timeout_s, stats,
+                                              fork_pairs=eligible_pairs)
         except Exception as error:
             import logging
 

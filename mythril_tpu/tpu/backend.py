@@ -295,7 +295,7 @@ class DeviceSolverBackend:
         aig, roots = aig_roots[0], aig_roots[1]
         return self.pack_cone(aig, roots)
 
-    def pack_cone(self, aig, roots):
+    def pack_cone(self, aig, roots, carry_lits=()):
         """Levelize one root cone through the pack cache (no pre-pack
         var-cap shortcut — component sub-cones are smaller than their
         parent query's num_vars, so the caller applies caps on the packed
@@ -303,14 +303,19 @@ class DeviceSolverBackend:
         HERE — the seam where pack work actually happens (the router packs
         ahead of the batch call via packed_hint, so timing only the batch
         loop under-reported the pack wall its byte volume was counted
-        against)."""
+        against). `carry_lits` (the fork lane): literals whose cones are
+        levelized in UNASSERTED so per-side extra roots can pin them —
+        keyed into the cache so a plain cone of the same roots can never
+        alias a carry cone."""
         from mythril_tpu.tpu import circuit
 
         skey = _circuit_struct_key(aig, roots)
+        if carry_lits:
+            skey = (skey, "carry", tuple(carry_lits))
 
         def _build():
             start = time.monotonic()
-            pc = circuit.PackedCircuit(aig, roots)
+            pc = circuit.PackedCircuit(aig, roots, carry_lits=carry_lits)
             self.pack_seconds += time.monotonic() - start
             return pc
 
@@ -607,6 +612,7 @@ class DeviceSolverBackend:
         cube_vars: int = 0,
         cube_min_levels: int = 64,
         stream_budget: Optional[int] = None,
+        extra_roots: Optional[Sequence] = None,
     ) -> List[Optional[List[bool]]]:
         """Solve a WINDOW of blasted queries as ONE ragged flat stream:
         the cones concatenate into a combined circuit with per-cone paged
@@ -633,7 +639,7 @@ class DeviceSolverBackend:
             jax, _ = self._modules()
         except Exception:
             return results
-        packed: List[Tuple[int, int, object, object]] = []
+        packed: List[Tuple] = []
         with trace_span("device.pack", cat="device",
                         queries=len(problems)):
             for qi, (num_vars, clauses, aig_roots) in enumerate(problems):
@@ -646,7 +652,12 @@ class DeviceSolverBackend:
                 if not pc.ok:
                     continue
                 dense = aig_roots[2] if len(aig_roots) > 2 else None
-                packed.append((qi, num_vars, pc, dense))
+                # per-query extra asserted roots (the fork lane's pinned
+                # fork literal), riding the cube mechanism
+                extra = (tuple(extra_roots[qi])
+                         if extra_roots is not None and extra_roots[qi]
+                         else ())
+                packed.append((qi, num_vars, pc, dense, extra))
         if not packed:
             return results
         call_start = time.monotonic()
@@ -659,7 +670,7 @@ class DeviceSolverBackend:
             steps = self.CIRCUIT_STEPS
 
         window_bytes = 0
-        entries = [(pc, ()) for _qi, _nv, pc, _d in packed]
+        entries = [(pc, extra) for _qi, _nv, pc, _d, extra in packed]
         solved, nbytes, _ = self._solve_ragged_stream(
             jax, circuit, entries, deadline, num_restarts, steps)
         window_bytes += nbytes
@@ -676,7 +687,7 @@ class DeviceSolverBackend:
                 # direct (router-less) callers get the shared default;
                 # the router passes its resolved budget instead
                 stream_budget = RAGGED_STREAM_BYTES_DEFAULT
-            for i, (_qi, _nv, pc, _dense) in enumerate(packed):
+            for i, (_qi, _nv, pc, _dense, extra) in enumerate(packed):
                 if i in solved or pc.num_levels < cube_min_levels:
                     continue
                 if time.monotonic() >= deadline - 0.05:
@@ -696,7 +707,8 @@ class DeviceSolverBackend:
                     continue
                 cubes_shipped += len(plan)
                 cube_solved, nbytes, cube_done = self._solve_ragged_stream(
-                    jax, circuit, [(pc, cube) for cube in plan],
+                    jax, circuit,
+                    [(pc, tuple(extra) + tuple(cube)) for cube in plan],
                     deadline, num_restarts, steps, stop_at_first=True)
                 window_bytes += nbytes
                 if cube_done and not cube_solved:
@@ -721,7 +733,7 @@ class DeviceSolverBackend:
         if cubes_shipped:
             stats.add_cube_dispatch(cubes_shipped, cube_refutes)
 
-        for i, (qi, num_vars, pc, dense) in enumerate(packed):
+        for i, (qi, num_vars, pc, dense, _extra) in enumerate(packed):
             assignment = solved.get(i)
             if assignment is None:
                 continue

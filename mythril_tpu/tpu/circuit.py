@@ -54,10 +54,16 @@ class PackedCircuit:
                  "out_idx", "a_var", "a_neg", "b_var", "b_neg",
                  "ga_var", "ga_neg", "gb_var", "gb_neg", "is_gate",
                  "root_var", "root_neg", "root_mask", "ok", "num_roots",
-                 "num_gates", "level_rows")
+                 "num_gates", "level_rows", "carry_local")
 
-    def __init__(self, aig, roots: List[int]):
+    def __init__(self, aig, roots: List[int], carry_lits=()):
+        """`carry_lits`: literals whose cones are levelized INTO the
+        circuit but NOT asserted as roots — the fork lane packs a pair's
+        shared base roots once and carries the fork literal's node so
+        each side can pin it via RaggedStream extra_roots (the cube
+        mechanism). carry_local maps their global vars to local ids."""
         self.ok = False
+        self.carry_local = {}
         gate_of_var = aig.gate_of_var  # incremental index (append-only AIG)
 
         live_roots = []
@@ -71,6 +77,7 @@ class PackedCircuit:
         # cone of influence + levelization (iterative)
         level = {0: 0}
         stack = [lit >> 1 for lit in live_roots]
+        stack.extend(lit >> 1 for lit in carry_lits if lit > 1)
         while stack:
             var = stack[-1]
             if var in level:
@@ -104,6 +111,9 @@ class PackedCircuit:
         local = {0: 0}
         for i, var in enumerate(cone_vars, start=1):
             local[var] = i
+        for lit in carry_lits:
+            if lit > 1:
+                self.carry_local[lit >> 1] = local[lit >> 1]
 
         by_level: List[List[int]] = [[] for _ in range(num_levels + 1)]
         for var, lv in level.items():

@@ -140,20 +140,24 @@ log = logging.getLogger(__name__)
 
 
 class _Unit:
-    """One device-dispatch unit: a whole monolithic query, or one
+    """One device-dispatch unit: a whole monolithic query, one
     projected component of a partitioned query (preanalysis/aig_partition
-    — the per-component AIG-root projection)."""
+    — the per-component AIG-root projection), or one SIDE of a fork
+    pair (shared base cone + the fork literal pinned via extra roots)."""
 
     __slots__ = ("qi", "component", "pc", "problem", "comp_dense",
-                 "resolved")
+                 "resolved", "extra", "fork")
 
-    def __init__(self, qi, component, pc, problem, comp_dense=None):
+    def __init__(self, qi, component, pc, problem, comp_dense=None,
+                 extra=(), fork=False):
         self.qi = qi
         self.component = component  # AIGComponent or None (monolith)
         self.pc = pc
         self.problem = problem      # (num_vars, clauses, aig_roots)
         self.comp_dense = comp_dense
         self.resolved = False
+        self.extra = tuple(extra)   # RaggedStream extra assumption roots
+        self.fork = fork            # fork-side feasibility cone
 
 
 class _SplitState:
@@ -837,6 +841,7 @@ class QueryRouter:
                 [unit.problem for unit in group],
                 budget_seconds=remaining,
                 packed_hint=[unit.pc for unit in group],
+                extra_roots=[unit.extra for unit in group],
                 cube_vars=self.cube_vars(),
                 cube_min_levels=self.cube_min_levels,
                 stream_budget=self.ragged_stream_budget,
@@ -853,12 +858,17 @@ class QueryRouter:
         problems: Sequence[Tuple[int, Sequence, Tuple]],
         timeout_s: float,
         stats=None,
+        fork_pairs=None,
     ) -> List[Optional[List[bool]]]:
         """Trace-instrumented entry (the router.dispatch stage); routing
-        logic lives in _dispatch_impl."""
+        logic lives in _dispatch_impl. `fork_pairs` marks (i, j) problem
+        pairs that are two sides of one batched JUMPI fork — the ragged
+        path packs a pair's shared cone once and pins the fork literal
+        per side via extra assumption roots."""
         with trace_span("router.dispatch", cat="router",
                         queries=len(problems)) as sp:
-            results = self._dispatch_impl(problems, timeout_s, stats)
+            results = self._dispatch_impl(problems, timeout_s, stats,
+                                          fork_pairs=fork_pairs)
             sp.set(hits=sum(1 for bits in results if bits is not None))
         return results
 
@@ -867,6 +877,7 @@ class QueryRouter:
         problems: Sequence[Tuple[int, Sequence, Tuple]],
         timeout_s: float,
         stats=None,
+        fork_pairs=None,
     ) -> List[Optional[List[bool]]]:
         """Route a batch of blasted sibling queries: tiny cones host-direct,
         oversize cones cap-rejected (counted), the rest level-bucketed into
@@ -924,6 +935,36 @@ class QueryRouter:
 
         buckets = {}  # bucket level -> list of _Unit
         states = {}   # query index -> _SplitState (partitioned queries)
+        fork_qis = set()       # every query index named in a fork pair
+        fork_consumed = set()  # packed via the shared-cone pair path
+        if fork_pairs:
+            for qt, qf in fork_pairs:
+                fork_qis.add(qt)
+                fork_qis.add(qf)
+            if use_ragged:
+                for qt, qf in fork_pairs:
+                    pair = self._pack_fork_pair(qt, qf, problems)
+                    if pair is None:
+                        continue
+                    pc, extra_taken, extra_fall = pair
+                    # fork cones ride the stream even when "tiny": the
+                    # fused step→solve path exists to put the branch's
+                    # feasibility on the SAME launch as the window's
+                    # other cones — a host shortcut here would re-open
+                    # the per-fork host round trip the lane removes
+                    # (UNSAT still belongs to the CDCL either way)
+                    if self._admission_ragged(pc) not in ("device",
+                                                          "tiny"):
+                        continue  # the sides route individually below
+                    buckets.setdefault(
+                        shape_bucket(pc.num_levels), []).extend((
+                            _Unit(qt, None, pc, problems[qt],
+                                  extra=extra_taken, fork=True),
+                            _Unit(qf, None, pc, problems[qf],
+                                  extra=extra_fall, fork=True),
+                        ))
+                    fork_consumed.add(qt)
+                    fork_consumed.add(qf)
         for qi, problem in enumerate(problems):
             num_vars, clauses, aig_roots = problem[:3]
             if num_vars == 0 or aig_roots is None:
@@ -933,11 +974,13 @@ class QueryRouter:
                 # preprocessor's shrinkage is visible here as smaller
                 # dispatched cones (bench compares preanalysis on/off)
                 stats.add_router_clauses(len(clauses))
+            if qi in fork_consumed:
+                continue  # riding the shared fork-pair cone
             partition = self._partition_for(aig_roots)
             if partition is not None:
                 state = self._plan_components(
                     qi, num_vars, aig_roots, partition, caps, buckets,
-                    stats, ragged=use_ragged)
+                    stats, ragged=use_ragged, fork=qi in fork_qis)
                 if state is not None:
                     states[qi] = state
                     continue
@@ -948,6 +991,11 @@ class QueryRouter:
                 continue  # trivially unsat roots: CDCL proves it
             verdict = (self._admission_ragged(pc) if use_ragged
                        else self._admission(pc, caps))
+            if verdict == "tiny" and use_ragged and qi in fork_qis:
+                # unpaired fork-side cones join the stream too (see the
+                # pair path above): fork feasibility belongs on the
+                # ragged launch, not in a per-cone host round trip
+                verdict = "device"
             if verdict == "cap":
                 self.backend.count_cap_reject(
                     under_floor=(pc.num_levels <= LEVEL_CAP_FLOOR
@@ -968,7 +1016,7 @@ class QueryRouter:
                 self.backend.count_cap_reject()
                 continue
             buckets.setdefault(shape_bucket(pc.num_levels), []).append(
-                _Unit(qi, None, pc, problem))
+                _Unit(qi, None, pc, problem, fork=qi in fork_qis))
 
         deadline = time.monotonic() + budget
         from mythril_tpu.resilience import breaker as breaker_mod
@@ -1173,6 +1221,10 @@ class QueryRouter:
             if stats is not None:
                 # no query-axis padding on a ragged stream: slots == cones
                 stats.add_device_dispatch(len(group), len(group), elapsed)
+                if any(unit.fork for unit in group):
+                    # fork-side feasibility cones rode this stream
+                    # (shared-cone extra-root pairs or per-side cones)
+                    stats.add_fork_stream_dispatch()
             self.record_dispatch(hits, elapsed, ragged=True)
             self._apply_group_bits(group, group_bits, results, states,
                                    problems, stats)
@@ -1227,6 +1279,47 @@ class QueryRouter:
             return "cost"
         return "device"
 
+    def _pack_fork_pair(self, qt, qf, problems):
+        """Shared-cone pack of one fork pair: both sides must have
+        blasted in the SAME AIG with root sets differing by exactly one
+        literal and its negation — the fork literal, which is the same
+        AIG node at opposite polarity because `cond != 0` and
+        `cond == 0` lower to one boolean. Holds whenever the pair's
+        shared base prepare produced identical base roots (the
+        incremental prefix resume's normal case); a pair the per-query
+        rewrites diverged returns None and its sides pack individually
+        — still one stream, still counted as fork traffic, just no page
+        sharing. Returns (shared PackedCircuit, taken-side extra roots,
+        fall-side extra roots) or None."""
+        art, arf = problems[qt][2], problems[qf][2]
+        if art is None or arf is None:
+            return None
+        try:
+            aig_t, roots_t = art[0], list(art[1])
+            aig_f, roots_f = arf[0], list(arf[1])
+        except (TypeError, IndexError, KeyError):
+            return None  # packed-hint style problems: no raw root view
+        if aig_t is not aig_f:
+            return None
+        set_t, set_f = set(roots_t), set(roots_f)
+        only_t, only_f = set_t - set_f, set_f - set_t
+        if len(only_t) != 1 or len(only_f) != 1:
+            return None
+        lit = next(iter(only_t))
+        if lit < 2 or next(iter(only_f)) != (lit ^ 1):
+            return None
+        shared = [root for root in roots_t if root != lit]
+        pc = self.backend.pack_cone(aig_t, shared, carry_lits=(lit,))
+        if pc is None or not pc.ok:
+            return None
+        lit_local = pc.carry_local.get(lit >> 1)
+        if not lit_local:
+            return None
+        want_taken = (lit & 1) == 0  # positive literal = node True
+        return (pc,
+                ((lit_local, want_taken),),
+                ((lit_local, not want_taken),))
+
     # -- per-component root projection (preanalysis/aig_partition) ----------
 
     @staticmethod
@@ -1242,8 +1335,8 @@ class QueryRouter:
             return None  # partitioning must never break routing
 
     def _plan_components(self, qi, num_vars, aig_roots, partition, caps,
-                         buckets, stats,
-                         ragged: bool = False) -> Optional["_SplitState"]:
+                         buckets, stats, ragged: bool = False,
+                         fork: bool = False) -> Optional["_SplitState"]:
         """Project a partitioned query onto dispatch units: trivial
         components (all-unit root sets) write their literals into the
         merge state directly, device-eligible components join the level
@@ -1268,7 +1361,7 @@ class QueryRouter:
                     qi, component, pc,
                     (comp_nv, comp_cnf,
                      (aig, list(component.roots), comp_dense)),
-                    comp_dense)
+                    comp_dense, fork=fork)
                 state.units.append(unit)
                 # not pc.ok here means the cone is past the device
                 # COMPILE caps (MAX_LEVELS/MAX_VARS) — the partition
@@ -1278,6 +1371,10 @@ class QueryRouter:
                 verdict = (self._admission_ragged(pc) if ragged
                            else self._admission(pc, caps)) if pc.ok \
                     else "cap"
+                if verdict == "tiny" and ragged and fork:
+                    # fork-side sub-cones join the stream like their
+                    # monolithic counterparts (see _dispatch_impl)
+                    verdict = "device"
                 if verdict == "device":
                     buckets.setdefault(
                         shape_bucket(pc.num_levels), []).append(unit)

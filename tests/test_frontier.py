@@ -51,6 +51,7 @@ def make_state(code_bytes, stack_ints=(), mem_bytes=b""):
 
 _BIN_BYTES = {
     "ADD": 0x01, "MUL": 0x02, "SUB": 0x03, "SIGNEXTEND": 0x0B,
+    "DIV": 0x04, "SDIV": 0x05, "MOD": 0x06, "SMOD": 0x07,
     "LT": 0x10, "GT": 0x11, "SLT": 0x12, "SGT": 0x13, "EQ": 0x14,
     "AND": 0x16, "OR": 0x17, "XOR": 0x18, "BYTE": 0x1A,
     "SHL": 0x1B, "SHR": 0x1C, "SAR": 0x1D,
@@ -181,7 +182,7 @@ def test_differential_random_runs_numpy():
             continue
         oracle = reference_step(state.clone(), run.end_pc)
         frame = dense.encode_frontier([state], run)
-        stack_out, mem, written, msize, min_gas, max_gas, ok, mem_log \
+        stack_out, mem, written, msize, min_gas, max_gas, ok, mem_log, _fork \
             = kernel.step_batch(run, frame, backend="numpy")
         assert ok[0], f"unexpected bail: {run.op_names}"
         dense.decode_state(state, run, stack_out, mem, written, msize,
@@ -211,7 +212,7 @@ def test_differential_random_runs_jax_vmapped_batches():
         oracles = [reference_step(s.clone(), run.end_pc) for s in siblings]
         pad = kernel.pad_slots(len(siblings))
         frame = dense.encode_frontier(siblings, run, pad_to=pad)
-        stack_out, mem, written, msize, min_gas, max_gas, ok, mem_log \
+        stack_out, mem, written, msize, min_gas, max_gas, ok, mem_log, _fork \
             = kernel.step_batch(run, frame, backend="jax")
         for i, (sibling, oracle) in enumerate(zip(siblings, oracles)):
             assert ok[i]
@@ -239,7 +240,7 @@ def test_huge_memory_offsets_exit_the_batch():
         if not dense.state_encodable(state, run):
             continue
         frame = dense.encode_frontier([state], run)
-        stack_out, mem, written, msize, min_gas, max_gas, ok, mem_log \
+        stack_out, mem, written, msize, min_gas, max_gas, ok, mem_log, _fork \
             = kernel.step_batch(run, frame, backend="numpy")
         if ok[0]:
             # completed in-window: must still match the oracle
@@ -272,7 +273,7 @@ def test_symbolic_passthrough_slots_keep_object_identity(monkeypatch):
     assert run.out_sources == (-1, 0)
     assert dense.state_encodable(state, run)
     frame = dense.encode_frontier([state], run)
-    stack_out, mem, written, msize, min_gas, max_gas, ok, mem_log \
+    stack_out, mem, written, msize, min_gas, max_gas, ok, mem_log, _fork \
         = kernel.step_batch(run, frame, backend="numpy")
     assert ok[0]
     dense.decode_state(state, run, stack_out, mem, written, msize,

@@ -57,11 +57,18 @@ TRAJECTORY_METRICS = (
     "cdcl_settles",
     "zero_missed_findings",
     "corpus.stress_dispatch.hex.tpu_wall_s",
+    # device-side branching: batched symbolic-JUMPI forks and the
+    # ragged streams their feasibility checks rode
+    "branch_fusion.forks",
+    "branch_fusion.fork_stream_dispatches",
 )
 
 _HIGHER_BETTER_RE = re.compile(
     r"(rate|speedup|hits|value|resumes|occupancy|findings_equal"
-    r"|zero_missed_findings|device_solved|flips)")
+    r"|zero_missed_findings|device_solved|flips"
+    # device-side branching going dark on the fixed corpus is a
+    # regression, not an informational change
+    r"|forks|stream_dispatches)")
 _LOWER_BETTER_RE = re.compile(
     r"(_s$|wall|cap_rejects|cdcl_settles|sol_gap|misses|fallbacks"
     r"|verify_rejects|degraded|deadline_trips|breaker_trips)")
@@ -162,6 +169,12 @@ def extract_metrics(payload: dict) -> Dict[str, object]:
     put("cache_warm.persistent_hits", cache.get("warm_persistent_hits"))
     parallel = extra.get("corpus_parallel") or {}
     put("corpus_parallel.speedup", parallel.get("speedup"))
+    fusion = (extra.get("branch_fusion") or {}).get("summary") or {}
+    put("branch_fusion.forks", fusion.get("forks_total"))
+    put("branch_fusion.fork_stream_dispatches",
+        fusion.get("fork_stream_dispatches_total"))
+    put("branch_fusion.findings_equal", fusion.get("findings_equal_all"))
+    put("branch_fusion.fallbacks_on", fusion.get("fallback_exits_on"))
     return out
 
 
