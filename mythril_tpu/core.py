@@ -30,11 +30,12 @@ class MythrilDisassembler:
         self.enable_online_lookup = enable_online_lookup
 
     def load_from_bytecode(self, code: str, bin_runtime: bool = False,
-                           address: Optional[str] = None) -> EVMContract:
+                           address: Optional[str] = None,
+                           name: Optional[str] = None) -> EVMContract:
         if bin_runtime:
-            contract = EVMContract(code=code, name="MAIN")
+            contract = EVMContract(code=code, name=name or "MAIN")
         else:
-            contract = EVMContract(creation_code=code, name="MAIN")
+            contract = EVMContract(creation_code=code, name=name or "MAIN")
         self.contracts.append(contract)
         return contract
 
@@ -210,7 +211,8 @@ class MythrilAnalyzer:
                 "disable_mutation_pruner", "disable_dependency_pruning",
                 "enable_state_merging", "enable_summaries", "solver_backend",
                 "solve_cache", "transaction_sequences", "beam_width",
-                "disable_coverage_strategy", "jobs", "no_preanalysis",
+                "disable_coverage_strategy", "jobs", "corpus_interleave",
+                "no_preanalysis",
                 "no_aig_opt", "no_incremental_prep", "no_vmap_frontier",
                 "no_ragged", "no_frontier_fork", "trace", "heartbeat",
                 "inject_fault",
@@ -265,10 +267,24 @@ class MythrilAnalyzer:
         all_issues: List[Issue] = []
         exceptions: List[str] = []
         try:
+            interleave_n = self._corpus_interleave_n()
             if args.jobs > 1 and len(self.contracts) > 1 \
                     and self.eth is None:
+                if interleave_n >= 1:
+                    # worker processes cannot share a coalescing window,
+                    # so no cross-contract stream can ever form there —
+                    # say so instead of letting xcontract_windows read 0
+                    # with no hint why
+                    log.warning(
+                        "--corpus-interleave is ignored under --jobs > 1 "
+                        "(process isolation precludes cross-contract "
+                        "windows); drop --jobs to interleave")
                 all_issues, exceptions = self._fire_lasers_parallel(
                     modules, tx_count)
+            elif interleave_n >= 1 and len(self.contracts) > 1 \
+                    and self.eth is None:
+                all_issues, exceptions = self._fire_lasers_interleaved(
+                    modules, tx_count, stats, interleave_n)
             else:
                 for contract in self.contracts:
                     issues, contract_exceptions = \
@@ -493,6 +509,85 @@ class MythrilAnalyzer:
                 issues, contract_exceptions = done[idx]
                 all_issues.extend(issues)
                 exceptions.extend(contract_exceptions)
+        return all_issues, exceptions
+
+    @staticmethod
+    def _corpus_interleave_n() -> int:
+        """Interleave width for the round-robin corpus driver: env
+        override first (MYTHRIL_TPU_CORPUS_INTERLEAVE), then the
+        --corpus-interleave flag. 0 = the legacy sequential path;
+        1 = the sequential BASELINE (same driver, same per-origin
+        isolation, one analysis at a time) the interleaved run's
+        findings are compared against; >= 2 = true interleaving."""
+        import os
+
+        env = os.environ.get("MYTHRIL_TPU_CORPUS_INTERLEAVE", "")
+        if env:
+            try:
+                return max(0, int(env))
+            except ValueError:
+                pass
+        return max(0, int(getattr(args, "corpus_interleave", 0) or 0))
+
+    def _fire_lasers_interleaved(self, modules, tx_count, stats, slots):
+        """Interleaved corpus driver (ROADMAP cross-contract packing):
+        up to `slots` contracts' analyses stepped round-robin in ONE
+        process on baton-passing threads (service/interleave.py — only
+        one thread executes at a time; the win is solve windows that MIX
+        origins, not CPU overlap). Each contract's slice of the
+        process-global engine state (wall budget, tx ids, keccak state,
+        module issue lists, memory/quick-sat solve tiers) is context-
+        switched at every handoff, so per-contract findings are
+        byte-identical to the sequential (interleave=1) schedule —
+        cross-contract reuse flows ONLY through the content-addressed
+        persistent tier, whose hits are replay-verified. Sibling queries
+        from different contracts park in the coalescing scheduler's
+        process-global window and ride ONE ragged device stream
+        (xcontract_windows counts the mixed launches)."""
+        import threading
+
+        from mythril_tpu.service import interleave
+
+        slots = max(1, min(slots, len(self.contracts)))
+        done = {}
+
+        def analyze_one(idx, contract):
+            done[idx] = self._analyze_one_contract(
+                contract, modules, tx_count, stats=stats)
+
+        coordinator = interleave.Coordinator(
+            list(enumerate(self.contracts)))
+        interleave.install(coordinator)
+        log.info("interleaved corpus driver: %d contracts over %d "
+                 "slot(s), quantum %d exec iterations",
+                 len(self.contracts), slots, coordinator.quantum)
+        threads = []
+        try:
+            for slot_id in range(slots):
+                thread = threading.Thread(
+                    target=coordinator.run_slot,
+                    args=(slot_id, analyze_one),
+                    name=f"mythril-interleave-{slot_id}")
+                thread.start()
+                threads.append(thread)
+            for thread in threads:
+                thread.join()
+        finally:
+            interleave.uninstall()
+        all_issues: List[Issue] = []
+        exceptions: List[str] = []
+        for idx, contract in enumerate(self.contracts):
+            if idx not in done:
+                # a slot thread died outside the per-contract capture
+                # (should not happen — _analyze_one_contract catches):
+                # surface the gap instead of reading as "safe"
+                exceptions.append(
+                    f"analysis of {contract.name} never completed "
+                    f"(interleaved corpus run)")
+                continue
+            issues, contract_exceptions = done[idx]
+            all_issues.extend(issues)
+            exceptions.extend(contract_exceptions)
         return all_issues, exceptions
 
     @staticmethod

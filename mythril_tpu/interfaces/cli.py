@@ -175,6 +175,15 @@ def add_analysis_args(parser) -> None:
     parser.add_argument("--jobs", type=int, default=1,
                         help="analyze contracts in N parallel worker "
                              "processes (corpus-level parallelism)")
+    parser.add_argument("--corpus-interleave", type=int, default=0,
+                        dest="corpus_interleave", metavar="N",
+                        help="step up to N contracts' analyses round-robin "
+                             "in ONE process so sibling solve queries from "
+                             "different contracts coalesce into the same "
+                             "device windows (cross-contract ragged "
+                             "packing); 1 = sequential baseline with the "
+                             "same per-contract isolation, 0 = off; env "
+                             "override: MYTHRIL_TPU_CORPUS_INTERLEAVE")
     parser.add_argument("--solver-log", help="directory for SMT2 query dumps")
     parser.add_argument("--solver-backend", default="cpu",
                         choices=["cpu", "tpu"],
@@ -292,15 +301,22 @@ def configure_logging(verbosity: int) -> None:
     )
 
 
-def load_code(parsed) -> List[str]:
-    """Hex blobs to analyze, one per contract (repeatable -f)."""
+def load_code(parsed) -> List[tuple]:
+    """(hex blob, contract name) pairs to analyze, one per contract
+    (repeatable -f). Single-input runs keep the reference's MAIN name;
+    multi-file corpus runs name each contract by its file basename so
+    per-contract findings stay attributable (the cross-contract bench
+    leg compares findings per contract, and a corpus of MAINs would be
+    indistinguishable)."""
     if parsed.code:
-        return [parsed.code]
+        return [(parsed.code, None)]
     if parsed.codefile:
         blobs = []
+        multi = len(parsed.codefile) > 1
         for path in parsed.codefile:
             with open(path) as handle:
-                blobs.append(handle.read().strip())
+                blobs.append((handle.read().strip(),
+                              os.path.basename(path) if multi else None))
         return blobs
     raise CliError(
         "no input: provide -c <hex>, -f <file>, -a <address>, or a .sol file"
@@ -335,9 +351,10 @@ def _build_disassembler_and_load(parsed):
         except ImportError as error:
             raise CliError(f"solidity support unavailable: {error}")
     else:
-        for blob in load_code(parsed):
+        for blob, name in load_code(parsed):
             disassembler.load_from_bytecode(
-                blob, bin_runtime=getattr(parsed, "bin_runtime", False)
+                blob, bin_runtime=getattr(parsed, "bin_runtime", False),
+                name=name,
             )
     return disassembler
 

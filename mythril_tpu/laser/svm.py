@@ -290,7 +290,14 @@ class LaserEVM:
             from mythril_tpu.laser.frontier import FrontierStepper
 
             self._frontier = FrontierStepper(self)
+        # interleaved-corpus yield point (service/interleave.py): under
+        # the round-robin corpus driver the baton rotates between
+        # contracts every quantum of exec iterations; one global load +
+        # None check when no coordinator is live
+        from mythril_tpu.service.interleave import tick as interleave_tick
+
         for global_state in self.strategy:
+            interleave_tick()
             if create and self.create_timeout:
                 if time.monotonic() - start > self.create_timeout:
                     log.info("create timeout reached")

@@ -1,0 +1,489 @@
+"""Interleaved corpus analysis: N contracts' analyses coexist in one
+process so their sibling solve queries can share ONE device stream.
+
+Why this exists: every device launch used to pack cones from exactly one
+contract's coalescing window, so corpus throughput was bounded by the
+per-contract query arrival rate rather than device occupancy — while
+nothing in the ragged paged layout (tpu/circuit.RaggedStream) requires
+cones to share a parent query, let alone a parent contract. The missing
+piece was a driver that makes queries from DIFFERENT contracts coexist
+in time. This module is that driver's machinery:
+
+  baton        N analyses run on N threads, but only ONE thread executes
+               at any instant — a baton (condition variable + current
+               slot id) is handed off cooperatively at explicit yield
+               points. The engine's process-global state (term intern
+               table, shared blaster AIG, module singletons, solver
+               caches) is therefore never mutated concurrently: the
+               scheduling is cooperative round-robin, not parallelism.
+               The win is windows that MIX origins, not CPU overlap.
+  yield points (a) every `quantum` exec-loop iterations (laser/svm.py
+               calls tick() — fairness: a stress_dispatch-class contract
+               cannot starve 2 s contracts of engine time), and (b) the
+               coalescing scheduler's solve seam: an analysis whose
+               sibling-query bundle was buffered PARKS instead of
+               demanding a flush, the baton passes to another analysis,
+               and only when every live analysis is parked (or none can
+               make progress) does the window flush — carrying queries
+               from every parked origin in ONE batched router dispatch.
+  contexts     the per-analysis slices of process-global engine state
+               are context-switched at every handoff: the wall-clock
+               budget (paused while the origin is off-baton), the tx-id
+               counter, the keccak/exponent function managers, every
+               detection module's issue/cache state, the in-memory
+               result tier + quick-sat model deque (per-origin — the
+               cross-contract reuse boundary is the content-addressed
+               persistent tier, whose replay-verified fingerprints are
+               origin-blind by design), and the ambient
+               detection-context flag. Isolation is what makes
+               per-contract findings independent of the schedule: the
+               interleaved run's findings are byte-identical to the
+               sequential (interleave=1) run's.
+
+Knobs: MYTHRIL_TPU_CORPUS_INTERLEAVE / --corpus-interleave selects the
+driver (core.MythrilAnalyzer._fire_lasers_interleaved);
+MYTHRIL_TPU_INTERLEAVE_QUANTUM sets the exec iterations per turn.
+"""
+
+import copy
+import logging
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import List, Optional
+
+log = logging.getLogger(__name__)
+
+DEFAULT_QUANTUM = 16  # exec-loop iterations per baton turn
+
+_active: Optional["Coordinator"] = None
+
+# origin -> (Blaster or None, term generation): each contract's private
+# blaster/AIG. The shared strashed AIG assigns node ids in first-use
+# order and the dense CNF sorts by id, so a process-wide blaster makes
+# the CDCL's branching — and hence which valid witness model it returns
+# — depend on which sibling contract blasted a common subterm first.
+# Per-origin blasters reproduce the solo-process id space exactly: the
+# property that makes interleaved findings BYTE-identical to the
+# sequential schedule. (None = lazily recreated on first use.)
+_blasters: dict = {}
+
+
+def active() -> Optional["Coordinator"]:
+    """The live coordinator, or None outside an interleaved corpus run."""
+    return _active
+
+
+def current_origin() -> Optional[str]:
+    """Origin tag (contract identity) of the analysis holding the baton.
+    None outside an interleaved run — single-contract invocations and
+    the legacy sequential path are origin-less by construction."""
+    coordinator = _active
+    return coordinator._current_origin if coordinator is not None else None
+
+
+def tick() -> None:
+    """Exec-loop yield point (laser/svm.py): hand the baton to the next
+    runnable analysis every `quantum` iterations. One global load + a
+    None check when no coordinator is live — the cost discipline every
+    always-on crossing in this codebase follows."""
+    coordinator = _active
+    if coordinator is not None:
+        coordinator.maybe_switch()
+
+
+def _install_blaster(origin) -> None:
+    from mythril_tpu.smt.solver import frontend
+
+    (frontend._global_blaster,
+     frontend._global_blaster_generation) = _blasters.get(origin,
+                                                          (None, -1))
+
+
+def _stash_blaster(origin) -> None:
+    from mythril_tpu.smt.solver import frontend
+
+    _blasters[origin] = (frontend._global_blaster,
+                         frontend._global_blaster_generation)
+
+
+@contextmanager
+def blaster_scope(origin):
+    """Temporarily install `origin`'s blaster over the ambient one — the
+    per-QUERY seam get_models_batch uses during a mixed window flush,
+    where one baton holder prepares several origins' queries: blasting a
+    sibling contract's terms into the flusher's AIG would re-couple the
+    id spaces the per-origin blasters exist to keep apart. No-op outside
+    the coordinator, for untagged queries, and when `origin` already
+    holds the baton."""
+    if _active is None or origin is None or origin == current_origin():
+        yield
+        return
+    from mythril_tpu.smt.solver import frontend
+
+    saved = (frontend._global_blaster, frontend._global_blaster_generation)
+    _install_blaster(origin)
+    try:
+        yield
+    finally:
+        _stash_blaster(origin)
+        (frontend._global_blaster,
+         frontend._global_blaster_generation) = saved
+
+
+class _EngineContext:
+    """One origin's slice of the process-global engine state.
+
+    install_fresh() gives a starting analysis pristine state (the same
+    state a solo-process analysis of the contract would see); save()
+    captures the live globals when the origin loses the baton; restore()
+    reinstalls them when it gets the baton back. State swapped by
+    object-identity-preserving `__dict__` replacement where the global
+    is a singleton other modules hold references to (function managers,
+    detection modules), and by module-attribute rebinding where call
+    sites re-read the attribute (support.model's memory tiers)."""
+
+    def __init__(self, origin: str, module_templates):
+        self.origin = origin
+        self._templates = module_templates
+        self._saved = None
+
+    @staticmethod
+    def capture_module_templates():
+        """Pristine per-module state snapshots, taken once at driver
+        start (right after core.fire_lasers reset every module): each
+        origin's fresh install copies from these, so a module attribute
+        added mid-run by one origin can never leak into another's."""
+        from mythril_tpu.analysis.module import ModuleLoader
+
+        return [
+            (module, {key: copy.copy(value)
+                      for key, value in module.__dict__.items()})
+            for module in ModuleLoader().get_detection_modules()
+        ]
+
+    def install_fresh(self) -> None:
+        from mythril_tpu.laser.function_managers import (
+            exponent_function_manager,
+            keccak_function_manager,
+        )
+        from mythril_tpu.laser.transaction.models import tx_id_manager
+        from mythril_tpu.smt.solver import frontend
+        from mythril_tpu.support import model as model_mod
+        from mythril_tpu.support.time_handler import time_handler
+
+        time_handler._start = None
+        time_handler._timeout = None
+        tx_id_manager._next = 0
+        # fresh per-origin blaster (see the _blasters registry note): a
+        # starting contract gets an empty AIG, exactly like a solo
+        # process (None = lazily recreated on first use)
+        _blasters[self.origin] = (None, -1)
+        frontend._global_blaster = None
+        frontend._global_blaster_generation = -1
+        keccak_function_manager.__dict__ = (
+            type(keccak_function_manager)().__dict__)
+        exponent_function_manager.__dict__ = (
+            type(exponent_function_manager)().__dict__)
+        for module, template in self._templates:
+            module.__dict__ = {key: copy.copy(value)
+                               for key, value in template.items()}
+        # the origin's memory tiers live in model.py's per-origin
+        # registry (get_models_batch resolves them PER QUERY during
+        # mixed flushes); installing them into the module globals serves
+        # the ambient call sites — get_model, the engine's direct
+        # quick-sat probes — while this origin holds the baton. Starting
+        # a contract drops any stale registry pair so each analysis
+        # starts as cold as a solo process would.
+        model_mod._origin_caches.pop(self.origin, None)
+        tier, quick_cache = model_mod.caches_for_origin(self.origin)
+        model_mod._result_cache = tier
+        model_mod.model_cache = quick_cache
+        model_mod._in_detection_context = False
+
+    def save(self) -> None:
+        from mythril_tpu.laser.function_managers import (
+            exponent_function_manager,
+            keccak_function_manager,
+        )
+        from mythril_tpu.laser.transaction.models import tx_id_manager
+        from mythril_tpu.support import model as model_mod
+        from mythril_tpu.support.time_handler import time_handler
+
+        # the execution-timeout clock PAUSES while the origin is
+        # off-baton: store elapsed-so-far, not the absolute start, so a
+        # contract's budget measures its own engine time — siblings'
+        # quanta must not burn it (and must not make the interleaved
+        # run's timeout behavior diverge from the sequential run's)
+        elapsed = (time.monotonic() - time_handler._start
+                   if time_handler._start is not None else None)
+        _stash_blaster(self.origin)
+        self._saved = {
+            "time": (elapsed, time_handler._timeout),
+            "txid": tx_id_manager._next,
+            "keccak": keccak_function_manager.__dict__,
+            "exponent": exponent_function_manager.__dict__,
+            "modules": [module.__dict__ for module, _t in self._templates],
+            "result_cache": model_mod._result_cache,
+            "model_cache": model_mod.model_cache,
+            "detection": model_mod._in_detection_context,
+        }
+
+    def restore(self) -> None:
+        from mythril_tpu.laser.function_managers import (
+            exponent_function_manager,
+            keccak_function_manager,
+        )
+        from mythril_tpu.laser.transaction.models import tx_id_manager
+        from mythril_tpu.support import model as model_mod
+        from mythril_tpu.support.time_handler import time_handler
+
+        saved = self._saved
+        self._saved = None
+        elapsed, timeout = saved["time"]
+        time_handler._timeout = timeout
+        time_handler._start = (time.monotonic() - elapsed
+                               if elapsed is not None else None)
+        tx_id_manager._next = saved["txid"]
+        _install_blaster(self.origin)
+        keccak_function_manager.__dict__ = saved["keccak"]
+        exponent_function_manager.__dict__ = saved["exponent"]
+        for (module, _t), state in zip(self._templates, saved["modules"]):
+            module.__dict__ = state
+        model_mod._result_cache = saved["result_cache"]
+        model_mod.model_cache = saved["model_cache"]
+        model_mod._in_detection_context = saved["detection"]
+
+
+class Coordinator:
+    """Cooperative round-robin scheduler over N analysis slots.
+
+    Exactly one slot holds the baton (self._current); the rest wait on
+    the shared condition. All queue/flag state is guarded by the
+    condition; engine-context save/restore runs inside the handoff while
+    the world is stopped (the old holder has not released the baton yet,
+    the new holder has not started), so the swap itself needs no extra
+    locking."""
+
+    def __init__(self, tasks, quantum: Optional[int] = None):
+        """`tasks`: list of (idx, contract) in corpus order. Origin tags
+        are minted here (index-qualified — corpus contracts loaded from
+        bytecode all share the name MAIN)."""
+        from mythril_tpu.support.env import env_float as _env_float
+
+        self._cond = threading.Condition()
+        self._tasks = deque(
+            (idx, contract, f"{idx}:{getattr(contract, 'name', '?')}")
+            for idx, contract in tasks)
+        self._waitq: deque = deque()
+        self._live = set()
+        self._current: Optional[int] = None
+        self._contexts = {}          # slot id -> _EngineContext or None
+        self._wants_flush = set()    # slots parked awaiting a window flush
+        self._parked_handles = {}    # slot id -> handles it is parked on
+        self._tls = threading.local()
+        self._current_origin: Optional[str] = None
+        self._ticks = 0
+        self.quantum = max(1, int(quantum if quantum is not None
+                                  else _env_float(
+                                      "MYTHRIL_TPU_INTERLEAVE_QUANTUM",
+                                      DEFAULT_QUANTUM)))
+        self._module_templates = _EngineContext.capture_module_templates()
+        # the pre-driver module globals, restored by uninstall() so the
+        # process's later origin-less work sees its own caches again
+        from mythril_tpu.support import model as model_mod
+
+        self._base_model_state = (model_mod._result_cache,
+                                  model_mod.model_cache,
+                                  model_mod._in_detection_context)
+
+    # -- slot lifecycle ------------------------------------------------------
+
+    def run_slot(self, slot_id: int, analyze_one) -> None:
+        """Slot thread main: claim the baton, then loop over corpus
+        tasks — fresh engine context per contract, a fairness yield
+        between contracts. `analyze_one(idx, contract)` is the driver's
+        per-contract closure (it must not raise; core's
+        _analyze_one_contract captures exceptions per contract)."""
+        self._attach(slot_id)
+        try:
+            while True:
+                if not self._tasks:
+                    return
+                idx, contract, origin = self._tasks.popleft()
+                context = _EngineContext(origin, self._module_templates)
+                with self._cond:
+                    self._contexts[slot_id] = context
+                context.install_fresh()
+                self._current_origin = origin
+                self._ticks = 0
+                try:
+                    analyze_one(idx, contract)
+                finally:
+                    with self._cond:
+                        self._contexts[slot_id] = None
+                    self._current_origin = None
+                # rotate between contracts so one slot cannot drain the
+                # whole task queue while siblings wait
+                self._handoff(ready_only=True)
+        finally:
+            self._detach(slot_id)
+
+    def _attach(self, slot_id: int) -> None:
+        self._tls.slot = slot_id
+        with self._cond:
+            self._live.add(slot_id)
+            if self._current is None:
+                self._current = slot_id
+                return
+            self._waitq.append(slot_id)
+            while self._current != slot_id:
+                self._cond.wait()
+            self._restore(slot_id)
+
+    def _detach(self, slot_id: int) -> None:
+        with self._cond:
+            self._live.discard(slot_id)
+            self._wants_flush.discard(slot_id)
+            self._parked_handles.pop(slot_id, None)
+            if self._current == slot_id:
+                self._current = None
+                if self._waitq:
+                    # any waiter may run next — a flush-parked slot that
+                    # wakes with no ready siblings flushes for itself
+                    self._current = self._waitq.popleft()
+                    self._cond.notify_all()
+
+    # -- baton handoff -------------------------------------------------------
+
+    def _pick_next(self, ready_only: bool) -> Optional[int]:
+        """Pop the next runnable slot off the wait queue (caller holds
+        the condition). ready_only skips flush-parked slots — handing
+        them the baton before their window flushed would just bounce it
+        back — UNLESS their parked handles have since resolved (a
+        sibling's flush, or a count/age-triggered one, already carried
+        their queries): those slots can make progress again."""
+        for _ in range(len(self._waitq)):
+            candidate = self._waitq.popleft()
+            if ready_only and candidate in self._wants_flush \
+                    and not all(handle.done for handle in
+                                self._parked_handles.get(candidate, ())):
+                self._waitq.append(candidate)
+                continue
+            return candidate
+        return None
+
+    def _handoff(self, ready_only: bool) -> bool:
+        """Give the baton to the next runnable slot and wait to be
+        rescheduled. Returns False (without switching) when no eligible
+        slot is waiting. Caller must hold the baton."""
+        me = self._tls.slot
+        with self._cond:
+            next_id = self._pick_next(ready_only)
+            if next_id is None:
+                return False
+            self._save(me)
+            self._waitq.append(me)
+            self._current = next_id
+            self._cond.notify_all()
+            while self._current != me:
+                self._cond.wait()
+            self._restore(me)
+        return True
+
+    def _save(self, slot_id: int) -> None:
+        context = self._contexts.get(slot_id)
+        if context is not None:
+            context.save()
+        self._current_origin = None
+
+    def _restore(self, slot_id: int) -> None:
+        context = self._contexts.get(slot_id)
+        if context is not None:
+            context.restore()
+            self._current_origin = context.origin
+        else:
+            self._current_origin = None
+        self._ticks = 0
+
+    def maybe_switch(self) -> None:
+        """Quantum yield point (module-level tick()). Only the baton
+        holder executes engine code, so no lock is needed for the tick
+        counter itself."""
+        self._ticks += 1
+        if self._ticks < self.quantum:
+            return
+        self._ticks = 0
+        self._handoff(ready_only=True)
+
+    # -- solve-seam parking (service/scheduler.py) ---------------------------
+
+    def park_for_results(self, scheduler, handles: List) -> None:
+        """An analysis buffered a sibling-query bundle: instead of
+        demanding an immediate flush (which would make every window
+        single-origin), park and let other analyses run up to THEIR
+        solve seams. When no sibling can make engine progress — all
+        parked or none left — whoever holds the baton flushes the
+        window, which now carries every parked origin's queries: the
+        cross-contract mixed window the ragged stream packs as one
+        launch."""
+        me = self._tls.slot
+        while True:
+            if all(handle.done for handle in handles):
+                return
+            with self._cond:
+                self._wants_flush.add(me)
+                self._parked_handles[me] = handles
+            try:
+                switched = self._handoff(ready_only=True)
+            finally:
+                with self._cond:
+                    self._wants_flush.discard(me)
+                    self._parked_handles.pop(me, None)
+            if not switched:
+                # nobody else can progress: this window is as mixed as
+                # it is going to get — flush it ourselves
+                self._flush_safely(scheduler, handles)
+
+    @staticmethod
+    def _flush_safely(scheduler, handles) -> None:
+        """Flush the shared window; a flush that dies wholesale (beyond
+        the per-query isolation scheduler._solve_group already provides)
+        must still resolve every parked origin's handles — an unresolved
+        handle would deadlock a SIBLING contract's analysis, which is
+        exactly the cross-origin fault leak the interleaved driver must
+        never allow. Leftovers degrade to unknown (possibly feasible):
+        precision on this window, never a missed finding, never a stuck
+        sibling."""
+        try:
+            scheduler.flush()
+        except Exception:
+            log.exception("interleaved window flush failed; degrading "
+                          "unresolved handles to unknown")
+            from mythril_tpu import resilience
+
+            resilience.record_event("scheduler.flush", "degraded")
+            scheduler.clear()
+
+
+def install(coordinator: Coordinator) -> None:
+    global _active
+    _active = coordinator
+
+
+def uninstall() -> None:
+    global _active
+    coordinator, _active = _active, None
+    _blasters.clear()
+    if coordinator is None:
+        return
+    from mythril_tpu.smt.solver import frontend
+    from mythril_tpu.support import model as model_mod
+
+    (model_mod._result_cache, model_mod.model_cache,
+     model_mod._in_detection_context) = coordinator._base_model_state
+    # the next origin-less solve starts on a fresh process-wide blaster
+    # rather than the last origin's private one
+    frontend._global_blaster = None
+    frontend._global_blaster_generation = -1

@@ -30,6 +30,21 @@ immediately — bit-identical to the pre-service path.
 
 Every flush is counted in SolverStatistics (window_flushes,
 coalesced_queries; coalesce_occupancy = queries per flush).
+
+Cross-contract windows (service/interleave.py): the window is
+PROCESS-GLOBAL and every buffered entry carries an ORIGIN tag (the
+contract identity minted by the interleaved corpus driver; None outside
+it). Under the interleave coordinator, solve_batch PARKS its bundle
+instead of demanding an immediate flush, so bundles from DIFFERENT
+contracts accumulate in one window and ride one batched router dispatch
+— the origins thread through get_models_batch to the ragged stream
+packer, which counts mixed-origin launches (xcontract_windows). Fair
+admission: when a window holds >= 2 origins, each flush group caps any
+single origin's share at MYTHRIL_TPU_ORIGIN_BUDGET queries and
+round-robins the origins, so a stress_dispatch-class contract's flood
+of sibling queries cannot push a 2 s contract's two cones out of the
+first dispatch (excess entries flush in follow-on groups of the same
+flush() call — nothing is dropped, only ordered).
 """
 
 import logging
@@ -47,6 +62,9 @@ DEFAULT_COALESCE_MAX = 16
 # bucketed path keeps the narrow default because its cost scales with
 # the padded slot count, not the window's summed gates
 DEFAULT_COALESCE_MAX_RAGGED = 64
+# per-origin share of one flush group when the window mixes origins:
+# bounds how much of a single batched dispatch one contract may occupy
+DEFAULT_ORIGIN_BUDGET = 32
 
 
 from mythril_tpu.support.env import env_float as _env_float
@@ -97,7 +115,14 @@ class CoalescingScheduler:
             pass
         self.max_batch = max(
             1, int(_env_float("MYTHRIL_TPU_COALESCE_MAX", default_max)))
-        self._buffer: List[tuple] = []  # (handle, constraint list, crosscheck)
+        self.origin_budget = max(
+            1, int(_env_float("MYTHRIL_TPU_ORIGIN_BUDGET",
+                              DEFAULT_ORIGIN_BUDGET)))
+        # entries: (handle, constraint list, crosscheck, origin tag,
+        # pair token) — the pair token is one shared object per fork
+        # pair (both sides of one batched JUMPI fork), None for plain
+        # traffic; flush rebuilds the router's fork_pairs hint from it
+        self._buffer: List[tuple] = []
         self._oldest: Optional[float] = None
 
     @property
@@ -123,17 +148,27 @@ class CoalescingScheduler:
             self.flush()
         return handle
 
-    def _buffer_one(self, handle, constraints, crosscheck) -> None:
+    def _buffer_one(self, handle, constraints, crosscheck,
+                    pair_key=None) -> None:
+        from mythril_tpu.service import interleave
+
         now = time.monotonic()
         if (self._buffer and self._oldest is not None
+                and interleave.active() is None
                 and (now - self._oldest) * 1000.0 >= self.window_ms):
             # the window expired while nobody demanded a result: flush the
-            # stale cohort before starting a new one
+            # stale cohort before starting a new one. Under the interleave
+            # coordinator the age trigger is suspended: parked bundles WAIT
+            # for sibling contracts' queries by design (wall-clock age
+            # mostly measures the siblings' engine quanta), and the
+            # coordinator flushes the window the moment no analysis can
+            # make progress — parked handles can never go stale
             self.flush()
             now = time.monotonic()
         if not self._buffer:
             self._oldest = now
-        self._buffer.append((handle, list(constraints), crosscheck))
+        self._buffer.append((handle, list(constraints), crosscheck,
+                             interleave.current_origin(), pair_key))
 
     def solve_batch(self, constraint_sets,
                     crosscheck: Optional[bool] = None) -> List:
@@ -143,7 +178,14 @@ class CoalescingScheduler:
         already bounded by the caller; splitting it across dispatches
         would halve bucket occupancy at exactly the seams routing exists
         for). Degrades to a direct get_models_batch call when coalescing
-        is disabled (bit-identical to the pre-service path)."""
+        is disabled (bit-identical to the pre-service path).
+
+        Under the interleave coordinator (service/interleave.py) the
+        bundle PARKS instead of demanding immediately: the baton passes
+        to sibling analyses, whose bundles join the same window, and the
+        eventual flush carries queries from every parked contract — the
+        cross-contract mixed window the ragged packer turns into one
+        launch."""
         if not self.enabled:
             from mythril_tpu.support.model import get_models_batch
 
@@ -153,6 +195,11 @@ class CoalescingScheduler:
             handle = SolveHandle(self)
             self._buffer_one(handle, constraints, crosscheck)
             handles.append(handle)
+        from mythril_tpu.service import interleave
+
+        coordinator = interleave.active()
+        if coordinator is not None and handles:
+            coordinator.park_for_results(self, handles)
         return [handle.result() for handle in handles]
 
     def solve_fork_batch(self, constraint_sets, pairs,
@@ -163,26 +210,55 @@ class CoalescingScheduler:
         bundle with `pairs` — (i, j) index pairs marking two sides of
         the same row — forwarded to the router's fork lane, which packs
         a pair's shared cone once and rides both sides on one ragged
-        stream with the fork literals as extra assumption roots. Any
-        already-buffered traffic flushes first so the pair indices stay
-        aligned with the bundle."""
+        stream with the fork literals as extra assumption roots.
+
+        Outside the interleave coordinator, any already-buffered traffic
+        flushes first so the pair indices stay aligned with the bundle
+        (the pre-interleave behavior, bit-identical). UNDER the
+        coordinator the bundle joins the shared window like any other
+        traffic — fork feasibility is the dominant solve stream on
+        branch-heavy contracts, so excluding it would leave mixed
+        windows starved — with each pair tagged by a shared token the
+        flush turns back into the router's fork_pairs hint (pairs are
+        kept atomic across fair-admission sub-groups)."""
         if not self.enabled:
             from mythril_tpu.support.model import get_models_batch
 
             return get_models_batch(constraint_sets, crosscheck=crosscheck,
                                     fork_pairs=pairs)
-        self.flush()
-        from mythril_tpu.smt.solver.statistics import SolverStatistics
-        from mythril_tpu.support.model import get_models_batch
+        from mythril_tpu.service import interleave
 
-        SolverStatistics().add_window_flush(len(constraint_sets))
-        return get_models_batch(constraint_sets, crosscheck=crosscheck,
-                                fork_pairs=pairs)
+        coordinator = interleave.active()
+        if coordinator is None:
+            self.flush()
+            from mythril_tpu.smt.solver.statistics import SolverStatistics
+            from mythril_tpu.support.model import get_models_batch
+
+            SolverStatistics().add_window_flush(len(constraint_sets))
+            return get_models_batch(constraint_sets, crosscheck=crosscheck,
+                                    fork_pairs=pairs)
+        pair_keys = {}
+        for i, j in pairs or ():
+            token = object()
+            pair_keys[i] = token
+            pair_keys[j] = token
+        handles = []
+        for index, constraints in enumerate(constraint_sets):
+            handle = SolveHandle(self)
+            self._buffer_one(handle, constraints, crosscheck,
+                             pair_key=pair_keys.get(index))
+            handles.append(handle)
+        if handles:
+            coordinator.park_for_results(self, handles)
+        return [handle.result() for handle in handles]
 
     def flush(self) -> None:
         """Solve everything buffered: one _solve_group per distinct
         crosscheck flag (submission order preserved per group; the group
-        solve and its per-query failure isolation live in _solve_group)."""
+        solve and its per-query failure isolation live in _solve_group).
+        Crosscheck groups holding >= 2 origins additionally split into
+        fair-admission sub-groups (_origin_groups) so no single contract
+        monopolizes one batched dispatch."""
         if not self._buffer:
             return
         from mythril_tpu.observe.tracer import span as trace_span
@@ -194,12 +270,71 @@ class CoalescingScheduler:
         groups = {}
         for entry in buffered:
             groups.setdefault(entry[2], []).append(entry)
-        with trace_span("scheduler.flush", cat="service",
-                        queries=len(buffered), groups=len(groups)):
-            for flag, entries in groups.items():
-                outcomes = self._solve_group(flag, entries)
-                for (handle, _c, _f), outcome in zip(entries, outcomes):
-                    handle._resolve(outcome)
+        try:
+            with trace_span("scheduler.flush", cat="service",
+                            queries=len(buffered), groups=len(groups)):
+                for flag, entries in groups.items():
+                    for group in self._origin_groups(entries):
+                        outcomes = self._solve_group(flag, group)
+                        for (handle, _c, _f, _o, _p), outcome in zip(
+                                group, outcomes):
+                            handle._resolve(outcome)
+        finally:
+            # the buffer was popped above, so an exception escaping the
+            # group loop (beyond _solve_group's per-query isolation —
+            # e.g. MemoryError mid-flush) would otherwise strand every
+            # popped handle unresolved FOREVER: no later flush can see
+            # them, and a parked interleaved analysis would spin on a
+            # handle nothing can complete. Any handle still pending
+            # degrades to unknown — precision, never a stuck caller.
+            for entry in buffered:
+                if not entry[0].done:
+                    entry[0]._resolve(("unknown", None))
+
+    def _origin_groups(self, entries: List[tuple]) -> List[List[tuple]]:
+        """Fair window-share admission: with >= 2 distinct origins in a
+        flush group, round-robin the origins with at most origin_budget
+        entries each per sub-group — every origin present in the window
+        lands in the FIRST dispatch, and a flood origin's overflow rides
+        follow-on sub-groups of the same flush. Fork pairs travel as one
+        atom so the router's shared-cone pair packing survives the
+        slicing. Single-origin (and untagged) windows pass through
+        untouched: bundles keep their one dispatch, exactly the
+        pre-interleave behavior."""
+        origins = {entry[3] for entry in entries}
+        if len(origins) < 2:
+            return [entries]
+        queues = {}   # origin -> list of atoms (1 entry, or a fork pair)
+        order = []
+        pending_pair = {}  # pair token -> atom awaiting its second side
+        for entry in entries:
+            origin = entry[3]
+            if origin not in queues:
+                queues[origin] = []
+                order.append(origin)
+            token = entry[4]
+            if token is not None and token in pending_pair:
+                pending_pair.pop(token).append(entry)
+                continue
+            atom = [entry]
+            queues[origin].append(atom)
+            if token is not None:
+                pending_pair[token] = atom
+        cursors = {origin: 0 for origin in order}
+        groups: List[List[tuple]] = []
+        while any(cursors[o] < len(queues[o]) for o in order):
+            group: List[tuple] = []
+            for origin in order:
+                queue, cursor = queues[origin], cursors[origin]
+                taken = 0
+                while cursor < len(queue) and taken < self.origin_budget:
+                    atom = queue[cursor]
+                    group.extend(atom)
+                    taken += len(atom)
+                    cursor += 1
+                cursors[origin] = cursor
+            groups.append(group)
+        return groups
 
     def _solve_group(self, flag, entries) -> List:
         """Solve one crosscheck-group of a window flush. Registered fault
@@ -213,11 +348,28 @@ class CoalescingScheduler:
         from mythril_tpu.resilience import maybe_inject, record_event
         from mythril_tpu.support.model import get_models_batch
 
+        # rebuild the router's fork-pair hint from the pair tokens (both
+        # sides of a pair always land in one group — _origin_groups
+        # slices atoms). Purely a packing hint: losing it costs page
+        # sharing, never a verdict, so the per-query retry path below
+        # simply drops it.
+        fork_pairs = []
+        first_side = {}
+        for position, entry in enumerate(entries):
+            token = entry[4]
+            if token is None:
+                continue
+            if token in first_side:
+                fork_pairs.append((first_side.pop(token), position))
+            else:
+                first_side[token] = position
         try:
             maybe_inject("scheduler.flush")
             return get_models_batch(
-                [constraints for _h, constraints, _f in entries],
+                [constraints for _h, constraints, _f, _o, _p in entries],
                 crosscheck=flag,
+                origins=[origin for _h, _c, _f, origin, _p in entries],
+                fork_pairs=fork_pairs or None,
             )
         except Exception:
             log.warning("coalesced solve flush failed; retrying the %d "
@@ -225,10 +377,11 @@ class CoalescingScheduler:
                         len(entries), exc_info=True)
             record_event("scheduler.flush", "retry")
         outcomes = []
-        for _handle, constraints, _f in entries:
+        for _handle, constraints, _f, origin, _p in entries:
             try:
-                outcomes.append(
-                    get_models_batch([constraints], crosscheck=flag)[0])
+                outcomes.append(get_models_batch(
+                    [constraints], crosscheck=flag,
+                    origins=[origin])[0])
             except Exception:
                 log.exception("query failed alone after a flush failure; "
                               "degrading it (only) to unknown")
@@ -241,7 +394,7 @@ class CoalescingScheduler:
         isolation); unresolved handles degrade to unknown."""
         buffered, self._buffer = self._buffer, []
         self._oldest = None
-        for handle, _c, _f in buffered:
+        for handle, _c, _f, _o, _p in buffered:
             handle._resolve(("unknown", None))
 
 

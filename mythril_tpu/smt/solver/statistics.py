@@ -76,6 +76,16 @@ class SolverStatistics:
         "paged_stream_bytes",
         "cubes_dispatched",
         "cube_device_refutes",
+        # cross-contract ragged packing (service/interleave.py driver +
+        # tpu/router.py origin-tagged windows): ragged streams that
+        # carried cones from >= 2 DISTINCT contracts in one launch, the
+        # cones those mixed streams packed, and persistent-tier entries
+        # (whole instances or FINGERPRINT SCHEMA 3 component sub-models)
+        # stored by one contract's analysis and reused by another's —
+        # the cross-contract dedup the content-addressed disk tier buys
+        "xcontract_windows",
+        "xcontract_cones_packed",
+        "xcontract_dedup_hits",
         # incremental cross-query preparation (smt/solver/incremental.py):
         # word-level work reused from sibling queries' prepares — memoized
         # simplify hits, prefix-snapshot resumes (suffix-only pipelines),
@@ -395,6 +405,24 @@ class SolverStatistics:
             self.ragged_windows += 1
             self.ragged_cones_packed += cones
             self.paged_stream_bytes += stream_bytes
+
+    def add_xcontract_window(self, cones: int) -> None:
+        """One ragged stream launch whose cones came from >= 2 distinct
+        origins (contracts) — the cross-contract packing seam actually
+        firing. `cones` is the stream's whole cone count: every cone on
+        a mixed stream shares the one launch the mixing amortizes."""
+        if self.enabled:
+            self.xcontract_windows += 1
+            self.xcontract_cones_packed += cones
+
+    def add_xcontract_dedup_hit(self, count: int = 1) -> None:
+        """A persistent-tier entry (whole-instance or component
+        sub-model) recorded by one contract's analysis and served to a
+        DIFFERENT contract's query this process — the disk tier's
+        content-addressed fingerprints deduping identical sub-cones
+        across contracts."""
+        if self.enabled:
+            self.xcontract_dedup_hits += count
 
     def add_cube_dispatch(self, cubes: int, refuted: int = 0) -> None:
         """One cube-and-conquer pass: `cubes` assumption-pinned replicas
@@ -754,6 +782,10 @@ class SolverStatistics:
                     f" {self.paged_stream_bytes} stream bytes,"
                     f" {self.cubes_dispatched} cubes"
                     f"/{self.cube_device_refutes} device refutes)")
+        if self.xcontract_windows or self.xcontract_dedup_hits:
+            out += (f", cross-contract: {self.xcontract_windows} mixed"
+                    f" windows ({self.xcontract_cones_packed} cones,"
+                    f" {self.xcontract_dedup_hits} dedup hits)")
         if self.resilience_events:
             out += (f", resilience: {self.resilience_retries} retries"
                     f"/{self.resilience_breaker_trips} breaker trips"

@@ -46,6 +46,10 @@ class _Args:
         self.beam_width = 8                    # --beam-search WIDTH
         self.transaction_sequences = None      # e.g. "[[0xa9059cbb],[-1]]"
         self.jobs = 1                          # corpus-parallel workers (-j)
+        self.corpus_interleave = 0             # --corpus-interleave N: step N
+        #   contracts' analyses round-robin in ONE process so their solve
+        #   windows mix (MYTHRIL_TPU_CORPUS_INTERLEAVE overrides; 0 = off,
+        #   1 = the sequential baseline with the same per-origin isolation)
         self.trace = None                      # --trace PATH (span tracer
         #   Perfetto export; MYTHRIL_TPU_TRACE is the env equivalent)
         self.heartbeat = None                  # --heartbeat PATH (live JSONL

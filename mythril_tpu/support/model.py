@@ -98,6 +98,55 @@ _result_cache: "OrderedDict" = OrderedDict()
 # by structural equality), so keep the cap modest to bound retention
 _RESULT_CACHE_MAX = 2 ** 12
 
+# per-origin memory tiers (interleaved corpus driver): each contract's
+# analysis gets its OWN term-keyed result cache and quick-sat deque, so
+# per-contract verdicts AND witness models are independent of which
+# sibling contracts shared the process and in what order — the
+# cross-contract reuse boundary is the content-addressed persistent
+# tier, never these. The interleave context installs an origin's pair
+# into the module globals for the ambient call sites (get_model, the
+# engine's direct quick-sat probes); get_models_batch resolves PER
+# QUERY because one mixed window flush carries several origins' queries
+# under a single baton holder.
+_origin_caches: dict = {}
+
+
+def caches_for_origin(origin):
+    """(result cache, model cache) for `origin`; the module globals
+    (whatever is currently installed) for origin-less traffic."""
+    if origin is None:
+        return _result_cache, model_cache
+    if origin not in _origin_caches:
+        _origin_caches[origin] = (OrderedDict(), ModelCache())
+    return _origin_caches[origin]
+
+# fingerprint -> origin tag of the analysis that FIRST persisted the
+# entry this process (interleaved corpus driver; entries written outside
+# an origin context are not recorded). Purely telemetry: a later hit
+# from a DIFFERENT origin counts xcontract_dedup_hits — the disk tier's
+# content-addressed fingerprints deduping identical (sub-)cones across
+# contracts. First-writer-wins and size-capped; never consulted for
+# verdicts (the replay-verification net is what makes hits safe).
+_fingerprint_origins: dict = {}
+_FINGERPRINT_ORIGIN_MAX = 1 << 16
+
+
+def _record_fingerprint_origin(fingerprint, origin) -> None:
+    if fingerprint is None or origin is None:
+        return
+    if fingerprint not in _fingerprint_origins \
+            and len(_fingerprint_origins) >= _FINGERPRINT_ORIGIN_MAX:
+        return
+    _fingerprint_origins.setdefault(fingerprint, origin)
+
+
+def _count_xcontract_hit(fingerprint, origin, stats) -> None:
+    """A persistent-tier hit whose entry was recorded by a DIFFERENT
+    origin this process — cross-contract dedup, counted."""
+    stored = _fingerprint_origins.get(fingerprint)
+    if stored is not None and origin is not None and stored != origin:
+        stats.add_xcontract_dedup_hit()
+
 
 def _cache_key(terms_list) -> Optional[tuple]:
     """Order- and multiplicity-insensitive key: the DEDUPLICATED constraint
@@ -151,7 +200,7 @@ def _prep_partition(prep):
         return None
 
 
-def _probe_component_assembly(store, solver, prep, stats):
+def _probe_component_assembly(store, solver, prep, stats, origin=None):
     """Disk-tier probe at COMPONENT granularity: when the monolithic
     fingerprint misses but every non-trivial component of the partitioned
     instance has a stored SAT sub-model, the components reassemble into a
@@ -172,6 +221,11 @@ def _probe_component_assembly(store, solver, prep, stats):
 
     aig, dense_q = prep.aig_roots[0], prep.aig_roots[2]
     merged = [False] * (prep.num_vars + 1)
+    # dedup attribution is deferred until the WHOLE assembly serves: a
+    # later component missing (or the merged model failing replay
+    # validation) means the probe served nothing, and counting the
+    # partial hits would inflate a trended bench metric
+    hit_fingerprints = []
     try:
         for component in partition.components:
             if apply_trivial_assignment(component, dense_q, merged):
@@ -183,6 +237,7 @@ def _probe_component_assembly(store, solver, prep, stats):
             if entry is None or entry.verdict != "sat" \
                     or entry.num_vars != comp_nv or entry.bits is None:
                 return None
+            hit_fingerprints.append(fingerprint)
             merge_component_bits(
                 comp_dense, dense_q, component_vars(comp_dense),
                 entry.bits, merged)
@@ -190,10 +245,13 @@ def _probe_component_assembly(store, solver, prep, stats):
     except Exception:
         stats.add_persistent_verify_reject()
         return None
+    for fingerprint in hit_fingerprints:
+        _count_xcontract_hit(fingerprint, origin, stats)
     return ("sat", model, True)
 
 
-def _persist_component_entries(store, prep, bits, stats) -> None:
+def _persist_component_entries(store, prep, bits, stats,
+                               origin=None) -> None:
     """Store each non-trivial component's sub-model under its own
     fingerprint so later queries sharing the sub-cone (under any parent)
     can reassemble it from disk."""
@@ -218,11 +276,12 @@ def _persist_component_entries(store, prep, bits, stats) -> None:
                 comp_nv, comp_cnf, component.roots, comp_dense)
             if store.store_sat(fingerprint, comp_nv, comp_bits):
                 stats.add_persistent_store()
+            _record_fingerprint_origin(fingerprint, origin)
     except Exception:
         pass  # persistence is best-effort; never break a solve
 
 
-def _probe_persistent(solver, prep, crosscheck, stats):
+def _probe_persistent(solver, prep, crosscheck, stats, origin=None):
     """Disk-tier lookup for a blasted instance.
 
     Returns (fingerprint, outcome): outcome is ("sat", Model, True) /
@@ -247,18 +306,19 @@ def _probe_persistent(solver, prep, crosscheck, stats):
     with trace_span("cache.probe", cat="service"):
         return _probe_persistent_store(
             store, instance_fingerprint(prep), solver, prep, crosscheck,
-            stats)
+            stats, origin=origin)
 
 
 def _probe_persistent_store(store, fingerprint, solver, prep, crosscheck,
-                            stats):
+                            stats, origin=None):
     if fingerprint is None:
         return None, None
     entry = store.lookup(fingerprint)
     if entry is None:
         # monolithic miss: a partitioned instance may still reassemble
         # from per-component entries stored by different parent queries
-        assembled = _probe_component_assembly(store, solver, prep, stats)
+        assembled = _probe_component_assembly(store, solver, prep, stats,
+                                              origin=origin)
         stats.add_persistent_lookup(hit=assembled is not None)
         return fingerprint, assembled
     if entry.verdict == "sat":
@@ -273,6 +333,7 @@ def _probe_persistent_store(store, fingerprint, solver, prep, crosscheck,
             stats.add_persistent_lookup(hit=False)
             return fingerprint, None
         stats.add_persistent_lookup(hit=True)
+        _count_xcontract_hit(fingerprint, origin, stats)
         return fingerprint, ("sat", model, True)
     if crosscheck and not entry.crosschecked:
         # detection-critical lookup, entry never got its second opinion:
@@ -280,6 +341,7 @@ def _probe_persistent_store(store, fingerprint, solver, prep, crosscheck,
         stats.add_persistent_lookup(hit=False)
         return fingerprint, None
     stats.add_persistent_lookup(hit=True)
+    _count_xcontract_hit(fingerprint, origin, stats)
     return fingerprint, ("unsat", None, entry.crosschecked)
 
 
@@ -302,7 +364,7 @@ def _crosscheck_confirmed(crosscheck: bool) -> bool:
 
 
 def _persist_result(fingerprint, prep, status, bits=None,
-                    crosscheck=False, stats=None) -> None:
+                    crosscheck=False, stats=None, origin=None) -> None:
     """Write a settled verdict into the disk tier (no-op when off)."""
     if fingerprint is None:
         return
@@ -311,12 +373,14 @@ def _persist_result(fingerprint, prep, status, bits=None,
         return
     if status == SAT:
         stored = store.store_sat(fingerprint, prep.num_vars, bits)
-        _persist_component_entries(store, prep, bits, stats)
+        _persist_component_entries(store, prep, bits, stats,
+                                   origin=origin)
     elif status == UNSAT:
         stored = store.store_unsat(
             fingerprint, crosschecked=_crosscheck_confirmed(crosscheck))
     else:
         return
+    _record_fingerprint_origin(fingerprint, origin)
     if stored and stats is not None:
         stats.add_persistent_store()
 
@@ -418,8 +482,11 @@ def _get_model_impl(
                 raise UnsatError()
             raise SolverTimeOutException()
 
+        from mythril_tpu.service.interleave import current_origin
+
+        origin = current_origin()
         fingerprint, cached_outcome = _probe_persistent(
-            solver, prep, crosscheck, stats)
+            solver, prep, crosscheck, stats, origin=origin)
         if cached_outcome is not None:
             verdict, model, memoizable = cached_outcome
             if verdict == "sat":
@@ -440,13 +507,15 @@ def _get_model_impl(
                 _store_result(key, model)
                 model_cache.put(model)
             _persist_result(fingerprint, prep, SAT, bits=prep.last_bits,
-                            crosscheck=crosscheck, stats=stats)
+                            crosscheck=crosscheck, stats=stats,
+                            origin=origin)
             return model
         if status == UNSAT:
             if key is not None:
                 _store_result(key, UNSAT)
             _persist_result(fingerprint, prep, UNSAT,
-                            crosscheck=crosscheck, stats=stats)
+                            crosscheck=crosscheck, stats=stats,
+                            origin=origin)
             raise UnsatError()
         raise SolverTimeOutException()
     finally:
@@ -459,6 +528,7 @@ def get_models_batch(
     solver_timeout: Optional[int] = None,
     crosscheck: Optional[bool] = None,
     fork_pairs=None,
+    origins=None,
 ) -> List:
     """Batched multi-query solve — THE production device fan-out.
 
@@ -484,13 +554,22 @@ def get_models_batch(
     still share their base roots packs ONCE and rides one ragged stream
     with the fork literals as extra assumption roots. Purely a routing
     hint — verdicts, caching, and the CDCL UNSAT oracle are untouched.
+
+    `origins` — per-query origin tags (contract identity, from the
+    interleaved corpus driver's coalescing window; None entries for
+    untagged traffic). Telemetry + routing hints only: the router
+    counts mixed-origin ragged streams (xcontract_windows) and orders
+    the window so streams actually mix; the persistent tier attributes
+    stored entries so cross-contract reuse is countable. Verdicts and
+    demux are index-based and untouched by tags.
     """
     with trace_span("solver.batch", cat="solver",
                     queries=len(constraint_sets)):
         return _get_models_batch_impl(constraint_sets,
                                       enforce_execution_time,
                                       solver_timeout, crosscheck,
-                                      fork_pairs=fork_pairs)
+                                      fork_pairs=fork_pairs,
+                                      origins=origins)
 
 
 def _get_models_batch_impl(
@@ -499,6 +578,7 @@ def _get_models_batch_impl(
     solver_timeout: Optional[int] = None,
     crosscheck: Optional[bool] = None,
     fork_pairs=None,
+    origins=None,
 ) -> List:
     from mythril_tpu.smt.solver.frontend import Solver
 
@@ -513,32 +593,52 @@ def _get_models_batch_impl(
         timeout_s = min(timeout_s, max(time_handler.time_remaining() - 0.5, 0.05))
 
     use_memory_tier = _memory_tier_enabled()
+    from mythril_tpu.service.interleave import blaster_scope, current_origin
+
+    if origins is None:
+        ambient = current_origin()
+        origins = [ambient] * len(constraint_sets)
+
+    def origin_of(index):
+        return origins[index] if index < len(origins) else None
+
     pending: List[tuple] = []  # (idx, key, fingerprint, solver, prep)
     start = time.monotonic()
     for idx, constraints in enumerate(constraint_sets):
         raw_constraints = [
             c.raw if isinstance(c, Expression) else c for c in constraints
         ]
+        # per-QUERY cache resolution: one mixed window flush solves
+        # several origins' queries under a single caller, so the module
+        # globals (the flusher's origin) would file sibling contracts'
+        # results — and later serve their witness models — into the
+        # wrong contract's tiers
+        tier, quick_cache = caches_for_origin(origin_of(idx))
         key = _cache_key(raw_constraints) if use_memory_tier else None
-        if key is not None and key in _result_cache:
-            cached = _result_cache[key]
+        if key is not None and key in tier:
+            cached = tier[key]
             stats.add_memory_hit()
             results[idx] = (
                 ("sat", cached) if isinstance(cached, Model) else ("unsat", None)
             )
             continue
-        quick = model_cache.check_quick_sat(raw_constraints)
+        quick = quick_cache.check_quick_sat(raw_constraints)
         if quick is not None:
             stats.add_quick_sat_hit()
             if key is not None:
                 # memoize the probe hit (same policy as get_model): the
                 # next lookup hits the term-keyed tier, not a deque scan
-                _store_result(key, quick)
+                _store_result(key, quick, tier)
             results[idx] = ("sat", quick)
             continue
         solver = Solver(timeout=timeout_s)
         solver.add(raw_constraints)
-        prep = solver._prepare([])
+        # per-query blaster scope: a mixed window flush prepares several
+        # origins' queries under one baton holder — each must blast into
+        # ITS contract's private AIG (id-space isolation is what keeps
+        # witness models schedule-independent)
+        with blaster_scope(origin_of(idx)):
+            prep = solver._prepare([])
         if prep.trivial is not None:
             if prep.trivial == SAT:
                 # preprocessing may have eliminated every constraint via
@@ -546,27 +646,27 @@ def _get_models_batch_impl(
                 model = solver._trivial_model(prep)
                 results[idx] = ("sat", model)
                 if key is not None:
-                    _store_result(key, model)
+                    _store_result(key, model, tier)
             elif prep.trivial == UNSAT:
                 results[idx] = ("unsat", None)
                 if key is not None:
-                    _store_result(key, UNSAT)
+                    _store_result(key, UNSAT, tier)
             else:
                 results[idx] = ("unknown", None)
             continue
         fingerprint, cached_outcome = _probe_persistent(
-            solver, prep, crosscheck, stats)
+            solver, prep, crosscheck, stats, origin=origin_of(idx))
         if cached_outcome is not None:
             verdict, model, memoizable = cached_outcome
             if verdict == "sat":
                 results[idx] = ("sat", model)
                 if key is not None:
-                    _store_result(key, model)
-                model_cache.put(model)
+                    _store_result(key, model, tier)
+                quick_cache.put(model)
             else:
                 results[idx] = ("unsat", None)
                 if key is not None and memoizable:
-                    _store_result(key, UNSAT)
+                    _store_result(key, UNSAT, tier)
             continue
         pending.append((idx, key, fingerprint, solver, prep))
 
@@ -610,8 +710,9 @@ def _get_models_batch_impl(
                     (position[i], position[j]) for i, j in fork_pairs
                     if i in position and j in position
                 ] or None
-            bits_list = get_router().dispatch(problems, timeout_s, stats,
-                                              fork_pairs=eligible_pairs)
+            bits_list = get_router().dispatch(
+                problems, timeout_s, stats, fork_pairs=eligible_pairs,
+                origins=[origin_of(entry[0]) for entry in eligible])
         except Exception as error:
             import logging
 
@@ -631,11 +732,13 @@ def _get_models_batch_impl(
                 still_pending.append((idx, key, fingerprint, solver, prep))
                 continue
             results[idx] = ("sat", model)
+            tier, quick_cache = caches_for_origin(origin_of(idx))
             if key is not None:
-                _store_result(key, model)
-                model_cache.put(model)
+                _store_result(key, model, tier)
+                quick_cache.put(model)
             _persist_result(fingerprint, prep, SAT, bits=bits,
-                            crosscheck=crosscheck, stats=stats)
+                            crosscheck=crosscheck, stats=stats,
+                            origin=origin_of(idx))
         pending = still_pending
 
     # CDCL settles the rest (and proves UNSAT); plain path, no device re-entry
@@ -647,20 +750,23 @@ def _get_models_batch_impl(
         status = solver._solve_prepared(prep)
         if capture_sink is not None:
             capture_sink.append((prep, status))
+        tier, quick_cache = caches_for_origin(origin_of(idx))
         if status == SAT:
             model = solver.model()
             results[idx] = ("sat", model)
             if key is not None:
-                _store_result(key, model)
-                model_cache.put(model)
+                _store_result(key, model, tier)
+                quick_cache.put(model)
             _persist_result(fingerprint, prep, SAT, bits=prep.last_bits,
-                            crosscheck=crosscheck, stats=stats)
+                            crosscheck=crosscheck, stats=stats,
+                            origin=origin_of(idx))
         elif status == UNSAT:
             results[idx] = ("unsat", None)
             if key is not None:
-                _store_result(key, UNSAT)
+                _store_result(key, UNSAT, tier)
             _persist_result(fingerprint, prep, UNSAT,
-                            crosscheck=crosscheck, stats=stats)
+                            crosscheck=crosscheck, stats=stats,
+                            origin=origin_of(idx))
         else:
             results[idx] = ("unknown", None)
     stats.add_host_route_seconds(time.monotonic() - settle_start)
@@ -668,15 +774,18 @@ def _get_models_batch_impl(
     return results
 
 
-def _store_result(key, value) -> None:
-    _result_cache[key] = value
-    while len(_result_cache) > _RESULT_CACHE_MAX:
-        _result_cache.popitem(last=False)
+def _store_result(key, value, cache=None) -> None:
+    target = cache if cache is not None else _result_cache
+    target[key] = value
+    while len(target) > _RESULT_CACHE_MAX:
+        target.popitem(last=False)
 
 
 def clear_caches() -> None:
     _result_cache.clear()
     model_cache.models.clear()
+    _origin_caches.clear()
+    _fingerprint_origins.clear()
     # service layer: buffered scheduler state is discarded and the
     # persistent-store handle released, so tests and --jobs workers start
     # clean — a cleared process re-populates from disk, not stale memory

@@ -115,6 +115,11 @@ Env summary (all optional):
                                 window that chunks consumes one per
                                 stream; default 4; 0 disables ragged
                                 dispatch there)
+  MYTHRIL_TPU_RAGGED_CHUNK_CONES  cones per assembled ragged stream
+                                (0 = auto: 2 in evidence mode where every
+                                fresh combined shape pays its compile
+                                inside the dispatch deadline, unbounded
+                                on a real device)
   MYTHRIL_TPU_CUBE_VARS         cube-and-conquer split width k (2^k
                                 cubes per hard cone; default 3 on the
                                 CPU platform, 7 on a real device; 0
@@ -146,10 +151,10 @@ class _Unit:
     pair (shared base cone + the fork literal pinned via extra roots)."""
 
     __slots__ = ("qi", "component", "pc", "problem", "comp_dense",
-                 "resolved", "extra", "fork")
+                 "resolved", "extra", "fork", "origin")
 
     def __init__(self, qi, component, pc, problem, comp_dense=None,
-                 extra=(), fork=False):
+                 extra=(), fork=False, origin=None):
         self.qi = qi
         self.component = component  # AIGComponent or None (monolith)
         self.pc = pc
@@ -158,6 +163,7 @@ class _Unit:
         self.resolved = False
         self.extra = tuple(extra)   # RaggedStream extra assumption roots
         self.fork = fork            # fork-side feasibility cone
+        self.origin = origin        # contract tag (cross-contract windows)
 
 
 class _SplitState:
@@ -275,6 +281,21 @@ class QueryRouter:
         # this cap.
         self.ragged_window_cap = int(
             _env_float("MYTHRIL_TPU_RAGGED_WINDOW_CAP", 4))
+        # cones per assembled stream for MIXED-ORIGIN windows (0 = auto:
+        # 2 in evidence mode, unbounded on a real device). Cross-contract
+        # windows make novel chunk compositions routine, and every new
+        # combined rectangle is a fresh XLA compile INSIDE the dispatch
+        # deadline — on the serialized virtual-CPU platform an 8-cone
+        # mixed shape's compile alone blew the hard deadline and tripped
+        # the breaker (4-cone shapes still tripped it intermittently).
+        # Small fixed mixed chunks keep the bucketed shape space tiny
+        # (compile cache stays warm) while still mixing origins: the
+        # window ordering round-robins origins BEFORE chunking, so even
+        # a 2-cone chunk carries 2 contracts. Single-origin windows are
+        # exempt — one launch covers the whole window, the PR-9
+        # invariant.
+        self.ragged_chunk_cones = int(
+            _env_float("MYTHRIL_TPU_RAGGED_CHUNK_CONES", 0))
         # ragged STREAMS dispatched this process: a coalescing window
         # that chunks under the byte/round budgets consumes one unit per
         # stream — each stream is its own serialized launch, and the
@@ -859,16 +880,22 @@ class QueryRouter:
         timeout_s: float,
         stats=None,
         fork_pairs=None,
+        origins=None,
     ) -> List[Optional[List[bool]]]:
         """Trace-instrumented entry (the router.dispatch stage); routing
         logic lives in _dispatch_impl. `fork_pairs` marks (i, j) problem
         pairs that are two sides of one batched JUMPI fork — the ragged
         path packs a pair's shared cone once and pins the fork literal
-        per side via extra assumption roots."""
+        per side via extra assumption roots. `origins` tags each problem
+        with its contract identity (cross-contract coalescing windows):
+        the ragged window interleaves origins so streams MIX, and every
+        launched stream carrying >= 2 distinct origins counts
+        xcontract_windows/xcontract_cones_packed."""
         with trace_span("router.dispatch", cat="router",
                         queries=len(problems)) as sp:
             results = self._dispatch_impl(problems, timeout_s, stats,
-                                          fork_pairs=fork_pairs)
+                                          fork_pairs=fork_pairs,
+                                          origins=origins)
             sp.set(hits=sum(1 for bits in results if bits is not None))
         return results
 
@@ -878,6 +905,7 @@ class QueryRouter:
         timeout_s: float,
         stats=None,
         fork_pairs=None,
+        origins=None,
     ) -> List[Optional[List[bool]]]:
         """Route a batch of blasted sibling queries: tiny cones host-direct,
         oversize cones cap-rejected (counted), the rest level-bucketed into
@@ -935,6 +963,12 @@ class QueryRouter:
 
         buckets = {}  # bucket level -> list of _Unit
         states = {}   # query index -> _SplitState (partitioned queries)
+
+        def origin_of(index):
+            if origins is None or index >= len(origins):
+                return None
+            return origins[index]
+
         fork_qis = set()       # every query index named in a fork pair
         fork_consumed = set()  # packed via the shared-cone pair path
         if fork_pairs:
@@ -959,9 +993,11 @@ class QueryRouter:
                     buckets.setdefault(
                         shape_bucket(pc.num_levels), []).extend((
                             _Unit(qt, None, pc, problems[qt],
-                                  extra=extra_taken, fork=True),
+                                  extra=extra_taken, fork=True,
+                                  origin=origin_of(qt)),
                             _Unit(qf, None, pc, problems[qf],
-                                  extra=extra_fall, fork=True),
+                                  extra=extra_fall, fork=True,
+                                  origin=origin_of(qf)),
                         ))
                     fork_consumed.add(qt)
                     fork_consumed.add(qf)
@@ -980,7 +1016,8 @@ class QueryRouter:
             if partition is not None:
                 state = self._plan_components(
                     qi, num_vars, aig_roots, partition, caps, buckets,
-                    stats, ragged=use_ragged, fork=qi in fork_qis)
+                    stats, ragged=use_ragged, fork=qi in fork_qis,
+                    origin=origin_of(qi))
                 if state is not None:
                     states[qi] = state
                     continue
@@ -1016,7 +1053,8 @@ class QueryRouter:
                 self.backend.count_cap_reject()
                 continue
             buckets.setdefault(shape_bucket(pc.num_levels), []).append(
-                _Unit(qi, None, pc, problem, fork=qi in fork_qis))
+                _Unit(qi, None, pc, problem, fork=qi in fork_qis,
+                      origin=origin_of(qi)))
 
         deadline = time.monotonic() + budget
         from mythril_tpu.resilience import breaker as breaker_mod
@@ -1134,6 +1172,13 @@ class QueryRouter:
         from mythril_tpu.tpu.circuit import MAX_VARS
 
         budget_s = self.ragged_chunk_budget_s()
+        # the cone cap applies only to cross-contract windows (>= 2
+        # origins): single-origin windows keep one-launch-per-window
+        cone_cap = 0
+        if len({unit.origin for unit in window
+                if unit.origin is not None}) >= 2:
+            cone_cap = self.ragged_chunk_cones \
+                or (2 if self._evidence_mode() else 0)
         # the same amortized assembly+upload wall admission charges: a
         # chunk packed to the raw round estimate alone would leave no
         # headroom for stream prep inside the dispatch deadline
@@ -1161,7 +1206,8 @@ class QueryRouter:
             unit_vars = max(unit.pc.v1 - 1, 0)
             merged, cells = combined_cells(chunk_rows, unit.pc)
             if chunks[-1] and (
-                    chunk_bytes + entry_bytes > self.ragged_stream_budget
+                    (cone_cap and len(chunks[-1]) >= cone_cap)
+                    or chunk_bytes + entry_bytes > self.ragged_stream_budget
                     or 1 + chunk_vars + unit_vars > MAX_VARS
                     or self.est_ragged_round_seconds(cells) + prep_s
                     > budget_s):
@@ -1193,6 +1239,7 @@ class QueryRouter:
                   for unit in buckets[level]]
         if not window:
             return
+        window = self._order_window(window)
         ragged_profile = {k: v for k, v in profile.items()
                           if k in ("num_restarts", "steps")}
         for group in self._chunk_ragged(window):
@@ -1225,9 +1272,43 @@ class QueryRouter:
                     # fork-side feasibility cones rode this stream
                     # (shared-cone extra-root pairs or per-side cones)
                     stats.add_fork_stream_dispatch()
+                if len({unit.origin for unit in group
+                        if unit.origin is not None}) >= 2:
+                    # this launch carried cones from >= 2 distinct
+                    # contracts — the cross-contract packing seam firing
+                    stats.add_xcontract_window(len(group))
             self.record_dispatch(hits, elapsed, ragged=True)
             self._apply_group_bits(group, group_bits, results, states,
                                    problems, stats)
+
+    @staticmethod
+    def _order_window(window: List[_Unit]) -> List[_Unit]:
+        """Cross-contract window ordering: with >= 2 distinct origins
+        present, round-robin the units by origin (per-origin order
+        preserved) before greedy chunking — otherwise the level-sorted
+        walk tends to place one contract's cones contiguously and a
+        chunk boundary would turn a mixed window into single-origin
+        streams. Single-origin / untagged windows keep the level order
+        (bit-identical to the pre-interleave layout)."""
+        tagged = {unit.origin for unit in window if unit.origin is not None}
+        if len(tagged) < 2:
+            return window
+        queues = {}
+        order = []
+        for unit in window:
+            if unit.origin not in queues:
+                queues[unit.origin] = []
+                order.append(unit.origin)
+            queues[unit.origin].append(unit)
+        mixed: List[_Unit] = []
+        cursor = 0
+        while len(mixed) < len(window):
+            for origin in order:
+                queue = queues[origin]
+                if cursor < len(queue):
+                    mixed.append(queue[cursor])
+            cursor += 1
+        return mixed
 
     def _admission(self, pc, caps) -> str:
         """THE device-admission policy, shared by monolithic queries and
@@ -1336,7 +1417,8 @@ class QueryRouter:
 
     def _plan_components(self, qi, num_vars, aig_roots, partition, caps,
                          buckets, stats, ragged: bool = False,
-                         fork: bool = False) -> Optional["_SplitState"]:
+                         fork: bool = False,
+                         origin=None) -> Optional["_SplitState"]:
         """Project a partitioned query onto dispatch units: trivial
         components (all-unit root sets) write their literals into the
         merge state directly, device-eligible components join the level
@@ -1361,7 +1443,7 @@ class QueryRouter:
                     qi, component, pc,
                     (comp_nv, comp_cnf,
                      (aig, list(component.roots), comp_dense)),
-                    comp_dense, fork=fork)
+                    comp_dense, fork=fork, origin=origin)
                 state.units.append(unit)
                 # not pc.ok here means the cone is past the device
                 # COMPILE caps (MAX_LEVELS/MAX_VARS) — the partition

@@ -463,7 +463,8 @@ def test_flush_failure_poisons_only_the_failing_query(monkeypatch):
 
     poison = ["BAD"]
 
-    def fake_get_models_batch(constraint_sets, crosscheck=None):
+    def fake_get_models_batch(constraint_sets, crosscheck=None,
+                              origins=None, fork_pairs=None):
         if any(cs == poison for cs in constraint_sets):
             raise RuntimeError("poisoned query")
         return [("sat", object()) for _ in constraint_sets]
@@ -491,7 +492,8 @@ def test_flush_success_path_untouched(monkeypatch):
 
     calls = []
 
-    def fake_get_models_batch(constraint_sets, crosscheck=None):
+    def fake_get_models_batch(constraint_sets, crosscheck=None,
+                              origins=None, fork_pairs=None):
         calls.append(len(constraint_sets))
         return [("unsat", None) for _ in constraint_sets]
 

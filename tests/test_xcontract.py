@@ -1,0 +1,468 @@
+"""Cross-contract ragged packing: the interleaved corpus driver
+(service/interleave.py), origin-tagged coalescing windows
+(service/scheduler.py), mixed-origin ragged streams (tpu/router.py),
+and the cross-contract dedup/parity properties.
+
+Layers:
+  * stream layout — cones from DIFFERENT source AIGs ("contracts") on
+    one flat stream: page disjointness and per-origin demux against
+    host AIG evaluation;
+  * seam — get_models_batch with origin tags packs a mixed stream and
+    counts xcontract_windows / xcontract_cones_packed, with per-query
+    demux intact;
+  * scheduler — fair admission (a flood origin cannot push a small
+    origin out of the first dispatch), fork-pair atomicity;
+  * driver — interleaved vs sequential findings BYTE-identical per
+    contract on the committed corpus, the chaos property that a device
+    fault during a mixed window degrades soundly for every contract,
+    and the cross-contract disk-tier dedup counter;
+  * corpus — the committed bench_inputs/corpus files match their
+    pinned manifest and regenerate deterministically.
+"""
+
+import glob
+import json
+import os
+import random
+
+import numpy as np
+import pytest
+
+from mythril_tpu.smt.solver.statistics import SolverStatistics
+from mythril_tpu.support import model as model_mod
+from mythril_tpu.support.args import args
+from mythril_tpu.tpu import router as router_mod
+from mythril_tpu.tpu.circuit import RaggedStream
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CORPUS_DIR = os.path.join(REPO_ROOT, "bench_inputs", "corpus")
+
+
+@pytest.fixture(autouse=True)
+def fresh_state():
+    stats = SolverStatistics()
+    stats.reset()
+    stats.enabled = True
+    model_mod.clear_caches()
+    router_mod.reset_router()
+    saved_backend = args.solver_backend
+    saved_interleave = args.corpus_interleave
+    saved_cache = args.solve_cache
+    yield
+    model_mod.clear_caches()
+    router_mod.reset_router()
+    stats.reset()
+    args.solver_backend = saved_backend
+    args.corpus_interleave = saved_interleave
+    args.solve_cache = saved_cache
+
+
+# -- stream layout: cones from different contracts ---------------------------
+
+
+def test_mixed_origin_stream_pages_disjoint_and_demux_per_cone():
+    """Cones packed from TWO different source AIGs (two contracts'
+    blasters) ride one flat stream: variable pages must not alias, and
+    every kernel-found model must decode — per cone — to an assignment
+    its OWN contract's AIG evaluation confirms."""
+    from tests.test_ragged import (
+        _eval_root,
+        _local_to_global,
+        _packed_cones,
+        _run_stream,
+    )
+
+    rng = random.Random(57)
+    # _packed_cones builds each cone in its own AIG — exactly the
+    # per-origin-blaster regime (one AIG per contract)
+    contract_a = _packed_cones(rng, 3)
+    contract_b = _packed_cones(rng, 3)
+    cones = [cone for pair in zip(contract_a, contract_b)
+             for cone in pair]  # interleaved origins, like _order_window
+    stream = RaggedStream([(pc, ()) for _a, _r, pc in cones])
+    assert stream.ok and stream.num_cones == 6
+    spans = sorted(stream.pages)
+    for (base_a, size_a), (base_b, _s) in zip(spans, spans[1:]):
+        assert base_a + size_a <= base_b, "variable pages must not alias"
+    x, found = _run_stream(stream)
+    assert found.any(axis=0)[: len(cones)].all(), \
+        "tiny random cones must all settle within one round"
+    for ci, (aig, roots, pc) in enumerate(cones):
+        lane = int(np.argmax(found[:, ci]))
+        assignment = _local_to_global(
+            pc, stream.cone_assignment(ci, x[lane]))
+        for root in roots:
+            assert _eval_root(aig, assignment, root), (ci, root)
+
+
+def test_order_window_round_robins_origins():
+    """With >= 2 origins present the ragged window interleaves origins
+    (per-origin order preserved) so greedy chunk boundaries cannot
+    produce single-origin streams; single-origin windows keep their
+    level order untouched."""
+    def unit(qi, origin):
+        return router_mod._Unit(qi, None, None, None, origin=origin)
+
+    window = [unit(0, "A"), unit(1, "A"), unit(2, "A"),
+              unit(3, "B"), unit(4, "B")]
+    mixed = router_mod.QueryRouter._order_window(window)
+    assert [u.origin for u in mixed] == ["A", "B", "A", "B", "A"]
+    assert [u.qi for u in mixed if u.origin == "A"] == [0, 1, 2]
+    single = [unit(0, "A"), unit(1, "A"), unit(2, None)]
+    assert router_mod.QueryRouter._order_window(single) is single
+
+
+# -- seam: origin-tagged get_models_batch ------------------------------------
+
+
+def _production_queries(tag, count, base=0):
+    from mythril_tpu.smt import Extract, ULT, symbol_factory
+
+    queries = []
+    for qi in range(base, base + count):
+        data = symbol_factory.BitVecSym(f"xc_{tag}_data_{qi}", 256)
+        value = symbol_factory.BitVecSym(f"xc_{tag}_value_{qi}", 256)
+        sender = symbol_factory.BitVecSym(f"xc_{tag}_sender_{qi}", 256)
+        selector = (0xAB125858 ^ (qi * 0x01010101)) & 0xFFFFFFFF
+        queries.append([
+            Extract(255, 224, data)
+            == symbol_factory.BitVecVal(selector, 32),
+            ULT(value, symbol_factory.BitVecVal(1 << 40, 256)),
+            sender != symbol_factory.BitVecVal(0, 256),
+            value + data != sender,
+        ])
+    return queries
+
+
+def test_mixed_origin_batch_counts_windows_and_demuxes_per_query():
+    """THE acceptance seam: production-shape queries from two origins
+    through get_models_batch pack at least one ragged stream carrying
+    cones from both contracts (xcontract_windows >= 1,
+    xcontract_cones_packed >= 2), and every verdict demuxes to its own
+    query — each returned model must satisfy ITS constraints (validated
+    reconstruction already guarantees this; asserted here per query
+    against the raw terms)."""
+    from mythril_tpu.support.model import get_models_batch
+
+    stats = SolverStatistics()
+    args.solver_backend = "tpu"
+    queries = (_production_queries("contractA", 2)
+               + _production_queries("contractB", 2, base=2))
+    origins = ["0:A", "0:A", "1:B", "1:B"]
+    outcomes = get_models_batch(queries, origins=origins)
+    assert [status for status, _m in outcomes] == ["sat"] * 4
+    assert stats.xcontract_windows >= 1
+    assert stats.xcontract_cones_packed >= 2
+    for constraints, (_status, model) in zip(queries, outcomes):
+        assert model.satisfies([c.raw for c in constraints])
+
+
+# -- scheduler: fair admission + fork-pair atomicity -------------------------
+
+
+def test_fair_admission_no_starvation_in_first_dispatch(monkeypatch):
+    """A stress_dispatch-class contract flooding the window must not
+    push a 2 s contract's queries out of the FIRST batched dispatch:
+    every origin present lands in sub-group one, and no origin exceeds
+    its budget per sub-group."""
+    from mythril_tpu.service.scheduler import CoalescingScheduler
+
+    monkeypatch.setenv("MYTHRIL_TPU_COALESCE_MS", "1000000")
+    monkeypatch.setenv("MYTHRIL_TPU_COALESCE_MAX", "1000")
+    monkeypatch.setenv("MYTHRIL_TPU_ORIGIN_BUDGET", "8")
+    scheduler = CoalescingScheduler()
+    calls = []
+
+    def fake_batch(constraint_sets, crosscheck=None, origins=None,
+                   fork_pairs=None):
+        calls.append(list(origins))
+        return [("unknown", None)] * len(constraint_sets)
+
+    monkeypatch.setattr(model_mod, "get_models_batch", fake_batch)
+    from mythril_tpu.service import interleave
+
+    # buffer directly (submit() would flush at max_batch): 40 from the
+    # flood origin, then 2 from the small one
+    for qi in range(40):
+        monkeypatch.setattr(interleave, "current_origin", lambda: "0:big")
+        scheduler._buffer_one(_handle(scheduler), [f"big{qi}"], None)
+    monkeypatch.setattr(interleave, "current_origin", lambda: "1:small")
+    scheduler._buffer_one(_handle(scheduler), ["small0"], None)
+    scheduler._buffer_one(_handle(scheduler), ["small1"], None)
+    scheduler.flush()
+    assert len(calls) >= 2, "flood origin must split across sub-groups"
+    first = calls[0]
+    assert first.count("1:small") == 2, \
+        "the small origin rides the FIRST dispatch in full"
+    assert first.count("0:big") <= 8, "per-origin budget on window share"
+    total = sum(group.count("0:big") for group in calls)
+    assert total == 40, "nothing dropped, only ordered"
+
+
+def _handle(scheduler):
+    from mythril_tpu.service.scheduler import SolveHandle
+
+    return SolveHandle(scheduler)
+
+
+def test_origin_groups_keep_fork_pairs_atomic(monkeypatch):
+    """A fork pair's two sides must land in the SAME fair-admission
+    sub-group (the shared-cone pair packing hint dies across a group
+    boundary), even when the budget boundary falls mid-pair."""
+    from mythril_tpu.service.scheduler import CoalescingScheduler
+
+    monkeypatch.setenv("MYTHRIL_TPU_ORIGIN_BUDGET", "3")
+    monkeypatch.setenv("MYTHRIL_TPU_COALESCE_MS", "1000000")
+    scheduler = CoalescingScheduler()
+    token = object()
+    entries = [
+        (None, ["a0"], None, "A", None),
+        (None, ["a1"], None, "A", None),
+        (None, ["a2-pair"], None, "A", token),
+        (None, ["a3-pair"], None, "A", token),
+        (None, ["b0"], None, "B", None),
+    ]
+    groups = scheduler._origin_groups(entries)
+    for group in groups:
+        count = sum(1 for entry in group if entry[4] is token)
+        assert count in (0, 2), "pair split across sub-groups"
+    flattened = [entry for group in groups for entry in group]
+    assert sorted(c[0] for _h, c, _f, _o, _p in flattened) == sorted(
+        c[0] for _h, c, _f, _o, _p in entries)
+
+
+def test_solve_group_rebuilds_fork_pair_hint(monkeypatch):
+    """The flush's get_models_batch call reconstructs fork_pairs from
+    the buffered pair tokens at the positions the entries actually
+    occupy."""
+    from mythril_tpu.service.scheduler import CoalescingScheduler
+
+    scheduler = CoalescingScheduler()
+    seen = {}
+
+    def fake_batch(constraint_sets, crosscheck=None, origins=None,
+                   fork_pairs=None):
+        seen["pairs"] = fork_pairs
+        return [("unknown", None)] * len(constraint_sets)
+
+    monkeypatch.setattr(model_mod, "get_models_batch", fake_batch)
+    token = object()
+    entries = [
+        (_handle(scheduler), ["plain"], None, "A", None),
+        (_handle(scheduler), ["taken"], None, "A", token),
+        (_handle(scheduler), ["fall"], None, "A", token),
+    ]
+    scheduler._solve_group(None, entries)
+    assert seen["pairs"] == [(1, 2)]
+
+
+def test_flush_resolves_every_popped_handle_on_wholesale_failure(
+        monkeypatch):
+    """flush() pops the buffer BEFORE solving, so an exception escaping
+    the group loop (beyond _solve_group's per-query isolation) must not
+    strand the popped handles — no later flush can see them, and a
+    parked interleaved sibling would wait on a handle nothing can
+    complete. Every popped handle degrades to unknown."""
+    from mythril_tpu.service.scheduler import CoalescingScheduler
+
+    monkeypatch.setenv("MYTHRIL_TPU_COALESCE_MS", "1000000")
+    scheduler = CoalescingScheduler()
+    handles = [_handle(scheduler), _handle(scheduler)]
+    for qi, handle in enumerate(handles):
+        scheduler._buffer_one(handle, [f"q{qi}"], None)
+
+    def explode(entries):
+        raise MemoryError("wholesale flush failure")
+
+    monkeypatch.setattr(scheduler, "_origin_groups", explode)
+    with pytest.raises(MemoryError):
+        scheduler.flush()
+    assert all(handle.done for handle in handles)
+    assert [handle.result() for handle in handles] == \
+        [("unknown", None)] * 2
+
+
+# -- committed corpus --------------------------------------------------------
+
+
+def test_corpus_matches_pinned_manifest():
+    """bench_inputs/corpus is deterministic and committed: the generator
+    reproduces the exact bytes the manifest pins — the corpus sweep leg
+    is meaningless if its inputs can drift between rounds."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "make_corpus", os.path.join(REPO_ROOT, "tools", "make_corpus.py"))
+    make_corpus = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(make_corpus)
+    corpus = make_corpus.build_corpus()
+    assert len(corpus) >= 4
+    assert make_corpus.verify(corpus) == []
+    # determinism: a second build is byte-identical
+    assert make_corpus.build_corpus() == corpus
+
+
+# -- driver: interleaved vs sequential parity --------------------------------
+
+
+class _CmdArgs:
+    execution_timeout = 120
+    transaction_count = 1
+    max_depth = 128
+    pruning_factor = 1.0
+
+
+def _analyze_corpus(files, interleave, backend="cpu", inject_fault=None):
+    from mythril_tpu import preanalysis
+    from mythril_tpu.core import MythrilAnalyzer, MythrilDisassembler
+
+    model_mod.clear_caches()
+    preanalysis.reset_caches()
+    router_mod.reset_router()
+    args.solver_backend = backend
+    args.corpus_interleave = interleave
+    args.inject_fault = inject_fault
+    try:
+        disassembler = MythrilDisassembler()
+        for path in files:
+            with open(path) as fd:
+                disassembler.load_from_bytecode(
+                    fd.read().strip(), name=os.path.basename(path))
+        analyzer = MythrilAnalyzer(disassembler, cmd_args=_CmdArgs(),
+                                   strategy="bfs")
+        report = analyzer.fire_lasers(transaction_count=1)
+    finally:
+        args.inject_fault = None
+    payload = json.loads(report.as_json())
+    per_contract = {}
+    for issue in payload["issues"]:
+        per_contract.setdefault(issue["contract"], []).append(
+            json.dumps(issue, sort_keys=True))
+    return {key: sorted(value) for key, value in
+            sorted(per_contract.items())}, payload
+
+
+def _corpus_files(count):
+    files = sorted(glob.glob(os.path.join(CORPUS_DIR, "*.hex")))
+    assert len(files) >= count, "committed corpus missing"
+    return files[:count]
+
+
+def test_interleaved_findings_byte_identical_to_sequential():
+    """THE parity acceptance: per-contract findings — full issue dicts
+    INCLUDING the solver-chosen tx_sequence witnesses — byte-identical
+    between the interleaved schedule and the sequential baseline
+    (interleave=1: same driver, same per-origin isolation, one contract
+    at a time). Per-origin blasters are what make even the witness
+    bytes schedule-independent: each contract's cone ids reproduce the
+    solo-process order exactly."""
+    files = _corpus_files(2)
+    sequential, seq_payload = _analyze_corpus(files, 1)
+    interleaved, int_payload = _analyze_corpus(files, 2)
+    assert sequential == interleaved
+    assert seq_payload["issues"], "vacuous parity proves nothing"
+    assert json.dumps(seq_payload, sort_keys=True) == json.dumps(
+        int_payload, sort_keys=True)
+
+
+def test_device_fault_mid_mixed_window_contains_to_sound_path():
+    """PR-8 containment under the interleaved driver: a device.dispatch
+    fault injected while a MIXED window is in flight must degrade that
+    window to the host CDCL without aborting (or changing the findings
+    of) ANY of the interleaved contracts."""
+    files = _corpus_files(2)
+    baseline, _ = _analyze_corpus(files, 2, backend="tpu")
+    stats = SolverStatistics()
+    stats.reset()
+    stats.enabled = True
+    faulted, _ = _analyze_corpus(
+        files, 2, backend="tpu",
+        inject_fault="device.dispatch:raise:n1")
+    assert stats.resilience_faults_injected >= 1, \
+        "the fault must actually fire mid-run"
+    assert sorted(faulted) == sorted(baseline), \
+        "every interleaved contract must still be analyzed"
+    for contract in baseline:
+        base_keys = sorted(
+            (json.loads(i)["swc-id"], json.loads(i)["function"],
+             json.loads(i)["address"]) for i in baseline[contract])
+        fault_keys = sorted(
+            (json.loads(i)["swc-id"], json.loads(i)["function"],
+             json.loads(i)["address"]) for i in faulted[contract])
+        assert base_keys == fault_keys, contract
+
+
+# -- cross-contract disk-tier dedup ------------------------------------------
+
+
+def test_xcontract_dedup_hits_counted_across_origins(tmp_path,
+                                                     monkeypatch):
+    """A persistent-tier entry stored under one contract's analysis and
+    served to another's identical query counts xcontract_dedup_hits —
+    the content-addressed fingerprints deduping identical cones across
+    contracts (per-origin memory tiers make the disk tier the ONLY
+    cross-contract reuse path, which is what makes the counter
+    meaningful)."""
+    from mythril_tpu.support.model import get_models_batch
+
+    monkeypatch.setenv("MYTHRIL_TPU_CACHE_DIR", str(tmp_path))
+    args.solve_cache = "disk"
+    args.solver_backend = "cpu"
+    stats = SolverStatistics()
+    query = _production_queries("dedup", 1)
+    first = get_models_batch([list(query[0])], origins=["0:contract_a"])
+    assert first[0][0] == "sat"
+    assert stats.persistent_stores >= 1
+    assert stats.xcontract_dedup_hits == 0
+    second = get_models_batch([list(query[0])], origins=["1:contract_b"])
+    assert second[0][0] == "sat"
+    assert stats.xcontract_dedup_hits >= 1
+    # same origin probing again is reuse, not CROSS-contract reuse
+    before = stats.xcontract_dedup_hits
+    get_models_batch([list(query[0])], origins=["0:contract_a"])
+    assert stats.xcontract_dedup_hits == before
+
+
+# -- plumbing ----------------------------------------------------------------
+
+
+def test_current_origin_none_outside_coordinator():
+    from mythril_tpu.service import interleave
+
+    assert interleave.active() is None
+    assert interleave.current_origin() is None
+    interleave.tick()  # must be a no-op, not a crash
+
+
+def test_corpus_interleave_env_overrides_flag(monkeypatch):
+    from mythril_tpu.core import MythrilAnalyzer
+
+    args.corpus_interleave = 0
+    monkeypatch.setenv("MYTHRIL_TPU_CORPUS_INTERLEAVE", "3")
+    assert MythrilAnalyzer._corpus_interleave_n() == 3
+    monkeypatch.delenv("MYTHRIL_TPU_CORPUS_INTERLEAVE")
+    args.corpus_interleave = 2
+    assert MythrilAnalyzer._corpus_interleave_n() == 2
+
+
+def test_multi_file_contracts_named_by_basename(tmp_path):
+    from mythril_tpu.interfaces.cli import load_code
+
+    one = tmp_path / "one.hex"
+    two = tmp_path / "two.hex"
+    one.write_text("6000")
+    two.write_text("6001")
+
+    class Parsed:
+        code = None
+        codefile = [str(one), str(two)]
+
+    assert load_code(Parsed()) == [("6000", "one.hex"),
+                                   ("6001", "two.hex")]
+
+    class Single:
+        code = None
+        codefile = [str(one)]
+
+    # single-input runs keep the reference's MAIN naming
+    assert load_code(Single()) == [("6000", None)]

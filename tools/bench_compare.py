@@ -61,6 +61,11 @@ TRAJECTORY_METRICS = (
     # ragged streams their feasibility checks rode
     "branch_fusion.forks",
     "branch_fusion.fork_stream_dispatches",
+    # cross-contract ragged packing: corpus throughput of the
+    # interleaved configuration (up = improvement) and the mixed-origin
+    # stream evidence going dark would be a regression
+    "xcontract.contracts_per_hour",
+    "xcontract.windows",
 )
 
 _HIGHER_BETTER_RE = re.compile(
@@ -68,7 +73,10 @@ _HIGHER_BETTER_RE = re.compile(
     r"|zero_missed_findings|device_solved|flips"
     # device-side branching going dark on the fixed corpus is a
     # regression, not an informational change
-    r"|forks|stream_dispatches)")
+    r"|forks|stream_dispatches"
+    # cross-contract packing: corpus throughput (contracts/hour) and
+    # mixed-origin windows both want to go UP
+    r"|per_hour|xcontract)")
 _LOWER_BETTER_RE = re.compile(
     r"(_s$|wall|cap_rejects|cdcl_settles|sol_gap|misses|fallbacks"
     r"|verify_rejects|degraded|deadline_trips|breaker_trips)")
@@ -175,6 +183,15 @@ def extract_metrics(payload: dict) -> Dict[str, object]:
         fusion.get("fork_stream_dispatches_total"))
     put("branch_fusion.findings_equal", fusion.get("findings_equal_all"))
     put("branch_fusion.fallbacks_on", fusion.get("fallback_exits_on"))
+    xcontract = extra.get("corpus_xcontract") or {}
+    put("xcontract.contracts_per_hour",
+        xcontract.get("contracts_per_hour"))
+    put("xcontract.contracts_per_hour_sequential",
+        xcontract.get("contracts_per_hour_sequential"))
+    put("xcontract.windows", xcontract.get("xcontract_windows"))
+    put("xcontract.cones_packed", xcontract.get("xcontract_cones_packed"))
+    put("xcontract.dedup_hits", xcontract.get("xcontract_dedup_hits"))
+    put("xcontract.findings_equal", xcontract.get("findings_equal"))
     return out
 
 

@@ -1,0 +1,279 @@
+"""Build the pinned multi-contract corpus for the cross-contract packing
+sweep (bench.py corpus_xcontract_leg + tests/test_xcontract.py).
+
+The interleaved corpus driver's whole claim — sibling queries from
+DIFFERENT contracts riding one ragged device stream at findings parity —
+needs a committed, deterministic multi-contract corpus to be measured
+against: hand-picking ad-hoc inputs per round would make contracts/hour
+incomparable across rounds. This tool assembles four small contracts
+with the in-repo EASM assembler (the same technique as
+tools/gen_stress_input.py, whose 33-function stress_dispatch would
+dominate the sweep wall — these are 2 s-class derivatives):
+
+  xc_dispatch_a/b   stress_dispatch-class derivatives: a 3-way selector
+                    dispatcher, per function a data-dependent branch
+                    chain over 256-bit calldata arithmetic (the cone
+                    class the router's level floor guarantees admission
+                    for) — variant b shifts selectors, slots, and branch
+                    constants so the two are distinct contracts of the
+                    same shape;
+  xc_sender_a/b     ether_send-class derivatives: a weakly-guarded
+                    attacker-directed value transfer (planted SWC-105
+                    family finding, keeping the sweep's lost-the-finding
+                    guard meaningful) plus branch chains. Both variants
+                    share ONE byte-identical function under the same
+                    selector — identical sub-cones across contracts, the
+                    disk tier's cross-contract dedup target.
+
+Deterministic: byte-identical output on every run, pinned by sha256 in
+bench_inputs/corpus/MANIFEST.json. Regenerate/verify:
+  python tools/make_corpus.py            # verify committed files
+  python tools/make_corpus.py --write    # rewrite corpus + manifest
+"""
+
+import hashlib
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mythril_tpu.disasm.asm import easm_to_code  # noqa: E402
+
+CORPUS_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "bench_inputs", "corpus",
+)
+MANIFEST_PATH = os.path.join(CORPUS_DIR, "MANIFEST.json")
+MANIFEST_SCHEMA = 1
+
+
+def _branch_function(i: int, sel_base: int, slot_base: int,
+                     const_base: int) -> str:
+    """One dispatcher target: a 2-deep data-dependent branch chain over
+    256-bit calldata arithmetic + storage writes — every JUMPI here
+    produces the deep borrow-chain cones the device path exists for."""
+    slot = slot_base + i
+    return f"""
+:func{i}
+    JUMPDEST
+    PUSH1 0x04
+    CALLDATALOAD
+    PUSH2 0x{const_base + i:04x}
+    GT
+    PUSH2 @f{i}_a
+    JUMPI
+    PUSH1 0x24
+    CALLDATALOAD
+    PUSH1 0x{slot:02x}
+    SSTORE
+    STOP
+:f{i}_a
+    JUMPDEST
+    PUSH1 0x24
+    CALLDATALOAD
+    PUSH1 0x{(i + 1) & 0xFF:02x}
+    ADD
+    PUSH2 0x{(const_base ^ 0x1F00) + i:04x}
+    LT
+    PUSH2 @f{i}_b
+    JUMPI
+    PUSH1 0x{slot:02x}
+    SLOAD
+    PUSH1 0x44
+    CALLDATALOAD
+    XOR
+    PUSH1 0x{slot:02x}
+    SSTORE
+    STOP
+:f{i}_b
+    JUMPDEST
+    PUSH1 0x{slot:02x}
+    SLOAD
+    PUSH1 0x24
+    CALLDATALOAD
+    MUL
+    PUSH1 0x{(slot + 64) & 0xFF:02x}
+    SSTORE
+    STOP
+"""
+
+
+# the byte-identical function both xc_sender variants carry under the
+# SAME selector: identical bodies blast into identical sub-cones, so the
+# disk tier's content-addressed fingerprints hit across the two
+# contracts (xcontract_dedup_hits)
+SHARED_SELECTOR = 0xD15EA5E0
+_SHARED_FUNCTION = """
+:shared
+    JUMPDEST
+    PUSH1 0x04
+    CALLDATALOAD
+    PUSH1 0x24
+    CALLDATALOAD
+    ADD
+    PUSH2 0x4242
+    GT
+    PUSH2 @shared_hit
+    JUMPI
+    STOP
+:shared_hit
+    JUMPDEST
+    PUSH1 0x04
+    CALLDATALOAD
+    PUSH1 0x7a
+    SSTORE
+    STOP
+"""
+
+
+def _dispatcher(entries) -> str:
+    """Selector ladder: [(selector, label), ...]."""
+    out = """
+    PUSH1 0x00
+    CALLDATALOAD
+    PUSH1 0xe0
+    SHR
+"""
+    for sel, label in entries:
+        out += f"""
+    DUP1
+    PUSH4 0x{sel:08x}
+    EQ
+    PUSH2 @{label}
+    JUMPI
+"""
+    return out + """
+    STOP
+"""
+
+
+def _payout_function() -> str:
+    """Attacker-directed value transfer behind a weak calldata guard —
+    the planted SWC-105-family finding (mirrors gen_stress_input's
+    payout block)."""
+    return """
+:payout
+    JUMPDEST
+    PUSH1 0x00
+    PUSH1 0x00
+    PUSH1 0x00
+    PUSH1 0x00
+    PUSH1 0x04
+    CALLDATALOAD
+    PUSH1 0x04
+    CALLDATALOAD
+    PUSH2 0xffff
+    CALL
+    STOP
+"""
+
+
+def _creation_wrapper(runtime: bytes) -> bytes:
+    init = easm_to_code(f"""
+        PUSH2 0x{len(runtime):04x}
+        PUSH1 0x0f
+        PUSH1 0x00
+        CODECOPY
+        PUSH2 0x{len(runtime):04x}
+        PUSH1 0x00
+        RETURN
+        STOP
+    """)
+    assert len(init) == 15
+    return init + runtime
+
+
+def _dispatch_variant(sel_base: int, slot_base: int, const_base: int) -> str:
+    entries = [(((sel_base + i * 0x01010101) & 0xFFFFFFFF), f"func{i}")
+               for i in range(3)]
+    body = "".join(_branch_function(i, sel_base, slot_base, const_base)
+                   for i in range(3))
+    return _creation_wrapper(
+        easm_to_code(_dispatcher(entries) + body)).hex()
+
+
+def _sender_variant(sel_base: int, slot_base: int, const_base: int) -> str:
+    entries = [
+        (((sel_base + i * 0x01010101) & 0xFFFFFFFF), f"func{i}")
+        for i in range(2)
+    ]
+    entries.append(((sel_base + 0x0F0F0F0F) & 0xFFFFFFFF, "payout"))
+    entries.append((SHARED_SELECTOR, "shared"))
+    body = "".join(_branch_function(i, sel_base, slot_base, const_base)
+                   for i in range(2))
+    return _creation_wrapper(easm_to_code(
+        _dispatcher(entries) + body + _payout_function()
+        + _SHARED_FUNCTION)).hex()
+
+
+def build_corpus() -> dict:
+    """name -> hex blob (creation bytecode, `analyze -f` ready)."""
+    return {
+        "xc_dispatch_a.hex": _dispatch_variant(0xB0000000, 0x20, 0x0140),
+        "xc_dispatch_b.hex": _dispatch_variant(0xC1000000, 0x48, 0x0230),
+        "xc_sender_a.hex": _sender_variant(0x90000000, 0x30, 0x0120),
+        "xc_sender_b.hex": _sender_variant(0xA5000000, 0x58, 0x0210),
+    }
+
+
+def manifest_of(corpus: dict) -> dict:
+    return {
+        "schema": MANIFEST_SCHEMA,
+        "files": {
+            name: hashlib.sha256(blob.encode()).hexdigest()
+            for name, blob in sorted(corpus.items())
+        },
+    }
+
+
+def verify(corpus: dict) -> list:
+    """Mismatches between the generated corpus and the committed files +
+    manifest; [] when everything is pinned and byte-identical."""
+    problems = []
+    try:
+        with open(MANIFEST_PATH) as fd:
+            manifest = json.load(fd)
+    except (OSError, ValueError) as error:
+        return [f"manifest unreadable: {error}"]
+    expected = manifest_of(corpus)
+    if manifest != expected:
+        problems.append("MANIFEST.json does not match the generated corpus")
+    for name, blob in corpus.items():
+        path = os.path.join(CORPUS_DIR, name)
+        try:
+            with open(path) as fd:
+                committed = fd.read().strip()
+        except OSError:
+            problems.append(f"{name}: missing from {CORPUS_DIR}")
+            continue
+        if committed != blob:
+            problems.append(f"{name}: committed bytes differ from generator")
+    return problems
+
+
+def main() -> int:
+    corpus = build_corpus()
+    if "--write" in sys.argv:
+        os.makedirs(CORPUS_DIR, exist_ok=True)
+        for name, blob in corpus.items():
+            with open(os.path.join(CORPUS_DIR, name), "w") as fd:
+                fd.write(blob + "\n")
+        with open(MANIFEST_PATH, "w") as fd:
+            json.dump(manifest_of(corpus), fd, indent=2, sort_keys=True)
+            fd.write("\n")
+        print(f"wrote {len(corpus)} corpus contracts + manifest to "
+              f"{CORPUS_DIR}")
+        return 0
+    problems = verify(corpus)
+    if problems:
+        print("FAIL: corpus is not pinned:", file=sys.stderr)
+        for problem in problems:
+            print(f"  {problem}", file=sys.stderr)
+        return 1
+    print(f"ok: {len(corpus)} corpus contracts match the pinned manifest")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
