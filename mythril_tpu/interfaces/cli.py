@@ -95,6 +95,18 @@ def build_parser() -> argparse.ArgumentParser:
     add_input_args(safe)
     add_analysis_args(safe)
 
+    serve = subparsers.add_parser(
+        "serve",
+        help="run the multi-tenant analyzer daemon (HTTP API on "
+             "localhost: POST /analyze, GET /healthz, GET /metrics)")
+    serve.add_argument(
+        "--port", type=int, default=None,
+        help="localhost listener port (0 = ephemeral; default: "
+             f"MYTHRIL_TPU_SERVE_PORT or 8311)")
+    serve.add_argument("-v", "--verbose", type=int, default=2,
+                       help="log level 0-5")
+    add_analysis_args(serve)
+
     concolic = subparsers.add_parser("concolic", help="concolic branch flipping")
     concolic.add_argument("input", help="concrete input json")
     concolic.add_argument("--branches", required=True,
@@ -397,6 +409,27 @@ def execute_command(parsed) -> int:
             print("Disassembly:\n")
             print(contract.get_creation_easm())
         return 0
+
+    if command == "serve":
+        from mythril_tpu.core import MythrilAnalyzer, MythrilDisassembler
+        from mythril_tpu.serve.daemon import (
+            DEFAULT_PORT,
+            PORT_ENV,
+            ServeDaemon,
+            serve_forever,
+        )
+
+        # copy the analysis flags into the args singleton exactly like
+        # an analyze run would (the daemon's requests inherit them)
+        MythrilAnalyzer(MythrilDisassembler(), cmd_args=parsed)
+        port = parsed.port
+        if port is None:
+            port = int(os.environ.get(PORT_ENV) or DEFAULT_PORT)
+        daemon = ServeDaemon(tx_count=parsed.transaction_count,
+                             modules=(parsed.modules.split(",")
+                                      if parsed.modules else None),
+                             http_port=port)
+        return serve_forever(daemon)
 
     if command == "concolic":
         try:

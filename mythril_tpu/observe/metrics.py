@@ -73,7 +73,8 @@ class Instrument(NamedTuple):
 # by construction — the lint closes the loop in the other direction
 # (every instrument must reach every consumer)
 _GAUGE_NAMES = (
-    "device_occupancy", "coalesce_occupancy", "frontier_batch_occupancy")
+    "device_occupancy", "coalesce_occupancy", "frontier_batch_occupancy",
+    "serve_tenant_window_share")
 _HISTOGRAM_NAMES = ("prepare_suffix_hist", "interp_opcode_wall")
 _ROOFLINE_FIELDS = ("attained", "attainable", "sol_gap_s")
 

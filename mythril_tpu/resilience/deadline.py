@@ -63,6 +63,17 @@ class _Runner:
         raise payload
 
 
+def new_runner() -> _Runner:
+    """A DEDICATED runner for a caller that must not share the
+    module-global one: the serve daemon runs whole request batches under
+    a deadline, and those batches themselves cross run_with_deadline for
+    device dispatch — on a shared runner the inner call would queue
+    behind the batch occupying the only runner thread and self-deadlock
+    into a spurious device deadline. The caller owns wedged-replacement
+    (check `.wedged`, drop the runner, call new_runner() again)."""
+    return _Runner()
+
+
 _runner: Optional[_Runner] = None
 _runner_lock = threading.Lock()
 

@@ -111,6 +111,25 @@ FAULT_SITES: Dict[str, FaultSite] = {
             ("raise", "exit"),
             "dead worker's pending contracts requeued into a fresh pool "
             "once, then analyzed in-process"),
+        FaultSite(
+            "serve.request", "serve/daemon", "quarantine",
+            ("raise",),
+            "the poisoned request alone answers `error`; sibling "
+            "tenants' requests in the same batch complete with findings "
+            "untouched"),
+        FaultSite(
+            "serve.admission", "serve/daemon", "disable",
+            ("raise",),
+            "fair tenant round-robin admission degrades to plain FIFO "
+            "ordering (session fuse after repeated faults); nothing is "
+            "dropped, only ordered"),
+        FaultSite(
+            "serve.worker", "serve/daemon", "retry",
+            ("raise", "hang"),
+            "wedged worker batch deadline-killed on a dedicated runner "
+            "thread (the abandoned body cancels at its next check); its "
+            "requests requeue into a fresh batch once, then answer "
+            "`incomplete` — never hung, siblings' results kept"),
     )
 }
 

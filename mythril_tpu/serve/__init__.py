@@ -1,0 +1,29 @@
+"""`mythril_tpu serve`: the fault-contained multi-tenant analyzer daemon.
+
+Every warm asset the stack builds — router calibration, XLA compile
+cache, disk result tier, session strash table, prefix-snapshot memos —
+used to be per-process, so each CLI invocation re-warmed from scratch.
+This package is the long-lived loop that amortizes them across requests:
+
+  daemon.py   the request queue in front of MythrilAnalyzer — bounded
+              admission with per-tenant budgets and explicit
+              `overloaded` backpressure, the PR-12 origin-tagged
+              coalescing window promoted to a cross-request multi-tenant
+              batcher (per-tenant engine contexts via
+              service/tenancy.py), per-request hard deadlines on a
+              dedicated runner thread with requeue-once-then-incomplete
+              semantics, graceful SIGTERM drain, and the three
+              registered fault sites (serve.request / serve.admission /
+              serve.worker).
+  httpd.py    the localhost HTTP listener: POST /analyze, POST /evict,
+              GET /healthz, GET /metrics (PR 10's Prometheus text
+              writer as a real endpoint).
+
+Restart posture is crash-only: the daemon persists nothing of its own —
+a restarted process re-warms from the durable tiers (disk result store,
+router calibration profile, XLA compile cache) under
+MYTHRIL_TPU_CACHE_DIR, exactly like any cold CLI invocation, just
+faster.
+"""
+
+from mythril_tpu.serve.daemon import ServeDaemon, ServeRequest  # noqa: F401

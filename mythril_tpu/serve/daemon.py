@@ -1,0 +1,714 @@
+"""The serve daemon: a request queue in front of MythrilAnalyzer whose
+failure envelope is typed like everything else in this repo.
+
+Request lifecycle:
+
+  submit      admission control under one lock: a draining daemon and a
+              full queue answer `rejected` IMMEDIATELY (explicit
+              backpressure — bounded queue depth instead of unbounded
+              latency), and a per-tenant budget caps how much of the
+              queue one tenant may occupy, so a flood tenant is the one
+              that hears `overloaded`, not its neighbors.
+  batch       the worker pops up to MYTHRIL_TPU_SERVE_BATCH admitted
+              requests — round-robin across tenants in arrival order
+              (registered fault site serve.admission: a fault in the
+              fair ordering degrades to plain FIFO for the session,
+              nothing dropped) — and runs them as ONE interleaved
+              cohort on the PR-12 baton coordinator with
+              tenant-qualified origins. Their sibling solve queries park
+              in the process-global coalescing window and ride mixed
+              ragged streams: the cross-request multi-tenant batcher.
+  contexts    per-tenant engine contexts (service/tenancy.py) start
+              WARM: a tenant's memory tier, quick-sat deque, private
+              blaster AIG, and prefix snapshots survive across its
+              requests (term-generation invalidation applies as ever),
+              so a repeat request on a warm daemon records strictly
+              fewer cdcl_settles. Cross-TENANT reuse flows only through
+              the content-addressed, replay-verified disk tier.
+  deadlines   each batch executes on a DEDICATED PR-8 runner thread
+              (resilience/deadline.new_runner — the shared runner would
+              self-deadlock under the nested device-dispatch deadline)
+              bounded by the largest per-request deadline. A wedged
+              batch is abandoned (serve.worker `deadline` event), its
+              cancel token stops the abandoned body at its next check,
+              parked scheduler handles are unwound (the PR-12
+              _flush_safely finally-resolution generalized to request
+              teardown: every buffered handle resolves, a sibling can
+              never hang on one), and the batch's unfinished requests
+              requeue ONCE into a fresh batch — a second failure
+              answers `incomplete`, never hangs.
+  poisoning   serve.request (quarantine): a request that fails alone —
+              injected fault or a genuinely poisoned input — answers
+              `error` by itself; batch siblings keep their results and
+              their findings stay byte-identical to a no-fault run
+              (per-origin isolation is what makes that a theorem rather
+              than a hope).
+  drain       SIGTERM: stop admitting, finish everything already
+              admitted, write the final reconciled heartbeat, stop the
+              listener. The drain wall is counted (serve_drain_wall).
+
+Knobs (all env; see README "Serve daemon"):
+  MYTHRIL_TPU_SERVE_QUEUE_MAX      bounded queue depth (64)
+  MYTHRIL_TPU_SERVE_TENANT_BUDGET  queued requests per tenant (8)
+  MYTHRIL_TPU_SERVE_BATCH          requests per interleaved batch (4)
+  MYTHRIL_TPU_SERVE_DEADLINE      per-request hard deadline seconds (120)
+  MYTHRIL_TPU_SERVE_DRAIN_TIMEOUT  drain wait before leftovers answer
+                                   `incomplete` (60)
+  MYTHRIL_TPU_SERVE_PORT           CLI default listener port (8311)
+"""
+
+import hashlib
+import json
+import logging
+import threading
+import time
+from typing import Dict, List, Optional
+
+from mythril_tpu.support.env import env_float
+
+log = logging.getLogger(__name__)
+
+QUEUE_MAX_ENV = "MYTHRIL_TPU_SERVE_QUEUE_MAX"
+TENANT_BUDGET_ENV = "MYTHRIL_TPU_SERVE_TENANT_BUDGET"
+BATCH_ENV = "MYTHRIL_TPU_SERVE_BATCH"
+DEADLINE_ENV = "MYTHRIL_TPU_SERVE_DEADLINE"
+DRAIN_TIMEOUT_ENV = "MYTHRIL_TPU_SERVE_DRAIN_TIMEOUT"
+PORT_ENV = "MYTHRIL_TPU_SERVE_PORT"
+
+DEFAULT_QUEUE_MAX = 64
+DEFAULT_TENANT_BUDGET = 8
+DEFAULT_BATCH = 4
+DEFAULT_DEADLINE_S = 120.0
+DEFAULT_DRAIN_TIMEOUT_S = 60.0
+DEFAULT_PORT = 8311
+
+
+def _env_int(name: str, default: int) -> int:
+    return max(1, int(env_float(name, default)))
+
+
+class ServeRequest:
+    """One tenant's analysis request, resolved to a terminal outcome
+    dict exactly once:
+
+      {"status": "ok", "issues": [...], "exceptions": [...]}
+      {"status": "error", "reason": ...}        poisoned request, alone
+      {"status": "rejected", "reason": "overloaded" | "draining"}
+      {"status": "incomplete", "reason": ...}   answered, never hung
+    """
+
+    _seq = [0]
+    _seq_lock = threading.Lock()
+
+    def __init__(self, tenant: str, code: str, name: Optional[str] = None,
+                 tx_count: Optional[int] = None,
+                 deadline_s: Optional[float] = None,
+                 bin_runtime: bool = False,
+                 modules: Optional[List[str]] = None):
+        with self._seq_lock:
+            self._seq[0] += 1
+            self.request_id = self._seq[0]
+        self.tenant = str(tenant)
+        self.code = code
+        self.name = name
+        self.tx_count = tx_count
+        self.deadline_s = deadline_s
+        self.bin_runtime = bin_runtime
+        self.modules = modules
+        # tenant-qualified, content-addressed origin: the SAME tenant
+        # resubmitting the SAME bytecode reuses its warm tiers; two
+        # tenants submitting files that share a basename can never
+        # share one (the isolation-audit property). The tenant id is
+        # colon-escaped so origin_in_session's first-colon split cannot
+        # be confused by an adversarial tenant string.
+        from mythril_tpu.service.tenancy import encode_session
+
+        digest = hashlib.sha256(code.encode()).hexdigest()[:12]
+        self.origin = f"{encode_session(self.tenant)}:{digest}"
+        self.contract = None          # built at admission
+        self.requeues = 0
+        self.submitted_at = None      # monotonic, set at admission
+        self.wait_s = None            # queue latency, set at batch pop
+        self.outcome: Optional[dict] = None
+        self._done = threading.Event()
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def resolve(self, outcome: dict) -> bool:
+        """First resolve wins; returns whether THIS call resolved (the
+        caller may count a terminal-outcome stat only when it did — a
+        drain-resolved `incomplete` must not also count `completed`
+        when its abandoned analysis eventually finishes)."""
+        if self._done.is_set():
+            return False
+        outcome.setdefault("request_id", self.request_id)
+        outcome.setdefault("tenant", self.tenant)
+        if self.wait_s is not None:
+            outcome.setdefault("wait_s", round(self.wait_s, 4))
+        self.outcome = outcome
+        self._done.set()
+        return True
+
+    def wait(self, timeout: Optional[float] = None) -> Optional[dict]:
+        self._done.wait(timeout)
+        return self.outcome
+
+
+class ServeDaemon:
+    def __init__(self, tx_count: int = 1,
+                 modules: Optional[List[str]] = None,
+                 http_port: Optional[int] = None,
+                 queue_max: Optional[int] = None,
+                 tenant_budget: Optional[int] = None,
+                 batch_max: Optional[int] = None,
+                 deadline_s: Optional[float] = None):
+        self.tx_count = tx_count
+        self.modules = modules
+        self.http_port = http_port   # None = no listener (in-process API)
+        self.port = None             # bound port, set by start()
+        self.queue_max = queue_max or _env_int(QUEUE_MAX_ENV,
+                                               DEFAULT_QUEUE_MAX)
+        self.tenant_budget = tenant_budget or _env_int(
+            TENANT_BUDGET_ENV, DEFAULT_TENANT_BUDGET)
+        self.batch_max = batch_max or _env_int(BATCH_ENV, DEFAULT_BATCH)
+        self.deadline_s = deadline_s or env_float(DEADLINE_ENV,
+                                                  DEFAULT_DEADLINE_S)
+        self.drain_timeout_s = env_float(DRAIN_TIMEOUT_ENV,
+                                         DEFAULT_DRAIN_TIMEOUT_S)
+        self._cv = threading.Condition()
+        self._queue: List[ServeRequest] = []   # arrival order
+        self._inflight: List[ServeRequest] = []
+        self._evicting: set = set()            # sessions mid-eviction
+        self._draining = False
+        self._stopping = False
+        self.drained = threading.Event()
+        self._worker: Optional[threading.Thread] = None
+        self._runner = None
+        self._templates = None
+        self._heartbeat = None
+        self._http = None
+        self._analyzer = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "ServeDaemon":
+        """Warm the engine plumbing once (the per-request cost a CLI
+        invocation pays every time) and start the worker + listener."""
+        from mythril_tpu.analysis.module import ModuleLoader
+        from mythril_tpu.core import MythrilAnalyzer, MythrilDisassembler
+        from mythril_tpu.observe import flightrec, metrics
+        from mythril_tpu.resilience import deadline as deadline_mod
+        from mythril_tpu.resilience import faults
+        from mythril_tpu.service import tenancy
+        from mythril_tpu.smt.solver.statistics import SolverStatistics
+        from mythril_tpu.support.args import args
+
+        for module in ModuleLoader().get_detection_modules():
+            module.reset_module()
+            module.reset_cache()
+        stats = SolverStatistics()
+        stats.enabled = True
+        faults.configure_from_env(getattr(args, "inject_fault", None))
+        flightrec.install()
+        self._heartbeat = metrics.start_heartbeat(
+            getattr(args, "heartbeat", None))
+        # pristine module templates captured ONCE: batch N's contexts
+        # must never inherit batch N-1's module state
+        self._templates = tenancy.capture_module_templates()
+        self._analyzer = MythrilAnalyzer(MythrilDisassembler())
+        self._runner = deadline_mod.new_runner()
+        self._worker = threading.Thread(
+            target=self._worker_loop, name="mythril-serve-worker",
+            daemon=True)
+        self._worker.start()
+        if self.http_port is not None:
+            from mythril_tpu.serve.httpd import ServeHTTP
+
+            self._http = ServeHTTP(self, self.http_port)
+            self._http.start()
+            self.port = self._http.port
+        log.info("serve daemon up: queue_max=%d tenant_budget=%d "
+                 "batch=%d deadline=%.0fs port=%s",
+                 self.queue_max, self.tenant_budget, self.batch_max,
+                 self.deadline_s, self.port)
+        return self
+
+    # -- admission -----------------------------------------------------------
+
+    def submit(self, tenant: str, code: str, name: Optional[str] = None,
+               tx_count: Optional[int] = None,
+               deadline_s: Optional[float] = None,
+               bin_runtime: bool = False,
+               modules: Optional[List[str]] = None) -> ServeRequest:
+        """Admit (or reject) one request. Always returns a request whose
+        outcome WILL resolve — rejected ones resolve immediately."""
+        from mythril_tpu.smt.solver.statistics import SolverStatistics
+
+        request = ServeRequest(tenant, code, name=name, tx_count=tx_count,
+                               deadline_s=deadline_s,
+                               bin_runtime=bin_runtime, modules=modules)
+        stats = SolverStatistics()
+        # parse the bytecode BEFORE taking the admission lock: a
+        # malformed request is answered now instead of poisoning a
+        # batch later, and a large contract's disassembly must not
+        # serialize every concurrent admission/healthz/batch-pop
+        # behind it
+        try:
+            request.contract = self._build_contract(request)
+        except Exception as error:
+            stats.add_serve_admission(False)
+            request.resolve({"status": "rejected",
+                             "reason": f"bad request: {error}"})
+            return request
+        with self._cv:
+            if self._draining or self._stopping:
+                stats.add_serve_admission(False)
+                request.resolve({"status": "rejected",
+                                 "reason": "draining"})
+                return request
+            from mythril_tpu.service.tenancy import origin_in_session
+
+            if any(origin_in_session(request.origin, session)
+                   for session in self._evicting):
+                # the tenant's memos are mid-eviction: admitting now
+                # would run a live context whose save/restore could
+                # reinstall the evicted tiers
+                stats.add_serve_admission(False)
+                request.resolve({"status": "rejected",
+                                 "reason": "evicting"})
+                return request
+            depth = len(self._queue) + len(self._inflight)
+            if depth >= self.queue_max:
+                stats.add_serve_admission(False)
+                request.resolve({"status": "rejected",
+                                 "reason": "overloaded"})
+                return request
+            tenant_depth = sum(
+                1 for r in self._queue + self._inflight
+                if r.tenant == request.tenant)
+            if tenant_depth >= self.tenant_budget:
+                stats.add_serve_admission(False)
+                request.resolve({"status": "rejected",
+                                 "reason": "overloaded"})
+                return request
+            request.submitted_at = time.monotonic()
+            stats.add_serve_admission(True)
+            self._queue.append(request)
+            self._cv.notify_all()
+        return request
+
+    @staticmethod
+    def _build_contract(request: ServeRequest):
+        from mythril_tpu.ethereum.evmcontract import EVMContract
+
+        name = request.name or "MAIN"
+        if request.bin_runtime:
+            return EVMContract(code=request.code, name=name)
+        return EVMContract(creation_code=request.code, name=name)
+
+    # -- batching ------------------------------------------------------------
+
+    def _next_batch(self) -> List[ServeRequest]:
+        """Pop the next cross-request batch (caller holds the lock).
+
+        Fair admission: tenants rotate in the arrival order of their
+        oldest queued request, one request per tenant per round, so one
+        tenant's backlog cannot monopolize a batch while another tenant
+        waits. Two requests sharing an ORIGIN (same tenant, same
+        bytecode) never share a batch — their warm context is one
+        object. Registered fault site serve.admission (disable): any
+        fault in the fair ordering — injected or real — degrades to
+        plain FIFO for the session; requests are only ever reordered,
+        never dropped."""
+        from mythril_tpu import resilience
+        from mythril_tpu.resilience import maybe_inject
+
+        batch: List[ServeRequest] = []
+        if not resilience.fuse_blown("serve.admission"):
+            try:
+                maybe_inject("serve.admission")
+                tenants: List[str] = []
+                for request in self._queue:
+                    if request.tenant not in tenants:
+                        tenants.append(request.tenant)
+                taken = set()
+                progressed = True
+                while len(batch) < self.batch_max and progressed:
+                    progressed = False
+                    for tenant in tenants:
+                        if len(batch) >= self.batch_max:
+                            break
+                        for request in self._queue:
+                            if id(request) in taken \
+                                    or request.tenant != tenant:
+                                continue
+                            if any(request.origin == b.origin
+                                   for b in batch):
+                                continue
+                            batch.append(request)
+                            taken.add(id(request))
+                            progressed = True
+                            break
+            except Exception:
+                resilience.note_stage_failure("serve.admission")
+                batch = []
+        if not batch:
+            # FIFO degradation (and the trivial single-tenant case):
+            # first-come first-served, distinct origins per batch
+            for request in self._queue:
+                if len(batch) >= self.batch_max:
+                    break
+                if any(request.origin == b.origin for b in batch):
+                    continue
+                batch.append(request)
+        for request in batch:
+            self._queue.remove(request)
+            self._inflight.append(request)
+        return batch
+
+    # -- worker --------------------------------------------------------------
+
+    def _worker_loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._queue and not self._stopping:
+                    self._cv.wait(0.2)
+                if self._stopping and not self._queue:
+                    return
+                batch = self._next_batch()
+            if batch:
+                try:
+                    self._execute_batch(batch)
+                finally:
+                    with self._cv:
+                        for request in batch:
+                            if request in self._inflight:
+                                self._inflight.remove(request)
+                        self._cv.notify_all()
+
+    def _execute_batch(self, batch: List[ServeRequest]) -> None:
+        from mythril_tpu.resilience import record_event
+        from mythril_tpu.resilience.deadline import StageDeadlineExceeded
+        from mythril_tpu.smt.solver.statistics import SolverStatistics
+
+        stats = SolverStatistics()
+        now = time.monotonic()
+        for request in batch:
+            if request.submitted_at is not None and request.wait_s is None:
+                request.wait_s = now - request.submitted_at
+                stats.add_serve_wait_seconds(request.wait_s)
+        deadline = max(
+            (r.deadline_s or self.deadline_s) for r in batch)
+        shared = {"cancelled": False, "coordinator": None}
+
+        def body():
+            from mythril_tpu.resilience import maybe_inject
+
+            # the serve.worker crossing sits BEFORE any engine state is
+            # touched: an injected hang wedges the runner here, the
+            # deadline abandons it, and the cancel token stops the
+            # abandoned body cold when the hang finally wakes — it never
+            # races the requeued batch over the engine globals
+            maybe_inject("serve.worker")
+            if shared["cancelled"]:
+                return
+            self._run_batch_body(batch, shared)
+
+        try:
+            self._runner.call(body, deadline)
+        except StageDeadlineExceeded:
+            self._abandon(shared)
+            record_event("serve.worker", "deadline")
+            log.warning("serve batch exceeded its %.1fs deadline; "
+                        "abandoning the wedged worker", deadline)
+            from mythril_tpu.resilience import deadline as deadline_mod
+
+            self._runner = deadline_mod.new_runner()
+            self._requeue_or_incomplete(batch, "deadline")
+        except Exception as error:
+            self._abandon(shared)
+            log.warning("serve batch failed (%r); requeueing its "
+                        "unfinished requests once", error)
+            self._requeue_or_incomplete(batch, repr(error))
+        finally:
+            self._teardown_batch()
+
+    @staticmethod
+    def _abandon(shared: dict) -> None:
+        """Stop an abandoned batch's slot threads: the cancel flag stops
+        the pre-coordinator body, and Coordinator.cancel() raises
+        BatchCancelled at every abandoned thread's next yield point —
+        abandoned analyses DIE instead of racing the requeued batch
+        over the process-global engine state."""
+        shared["cancelled"] = True
+        coordinator = shared.get("coordinator")
+        if coordinator is not None:
+            coordinator.cancel()
+
+    def _run_batch_body(self, batch: List[ServeRequest],
+                        shared: Optional[dict] = None) -> None:
+        """Run one admitted batch as an interleaved cohort (executes on
+        the dedicated runner thread). Width-1 batches ride the same
+        coordinator: identical per-origin isolation, identical code
+        path, just no sibling to mix windows with."""
+        from mythril_tpu.service import interleave
+        from mythril_tpu.smt.solver.statistics import SolverStatistics
+
+        SolverStatistics().add_serve_batch(
+            len(batch), len({r.tenant for r in batch}))
+        tasks = [(idx, request.contract)
+                 for idx, request in enumerate(batch)]
+        coordinator = interleave.Coordinator(
+            tasks, origins=[request.origin for request in batch],
+            warm=True, module_templates=self._templates)
+        if shared is not None:
+            shared["coordinator"] = coordinator
+            if shared["cancelled"]:
+                return
+        interleave.install(coordinator)
+        threads = []
+
+        def slot_main(slot_id):
+            try:
+                coordinator.run_slot(slot_id,
+                                     self._make_analyze_one(batch))
+            except interleave.BatchCancelled:
+                pass  # abandoned batch: dying quietly is the contract
+
+        try:
+            for slot_id in range(len(batch)):
+                thread = threading.Thread(
+                    target=slot_main, args=(slot_id,),
+                    name=f"mythril-serve-slot-{slot_id}")
+                thread.start()
+                threads.append(thread)
+            for thread in threads:
+                thread.join()
+        finally:
+            # compare-and-swap teardown: if this body was abandoned and
+            # a NEWER batch installed its own coordinator, leave it
+            # alone (the check-and-pop is atomic inside uninstall)
+            interleave.uninstall(keep_tenancy=True, expected=coordinator)
+
+    def _make_analyze_one(self, batch: List[ServeRequest]):
+        def analyze_one(idx, contract):
+            self._analyze_request(batch[idx])
+
+        return analyze_one
+
+    def _analyze_request(self, request: ServeRequest) -> None:
+        """One request, inside its own engine context (the coordinator
+        installed it). Registered fault site serve.request (quarantine):
+        ANY failure here — injected or a genuinely poisoned contract —
+        answers `error` for this request alone; batch siblings are
+        isolated by construction."""
+        from mythril_tpu import resilience
+        from mythril_tpu.analysis.report import Report
+        from mythril_tpu.resilience import maybe_inject
+        from mythril_tpu.smt.solver.statistics import SolverStatistics
+
+        stats = SolverStatistics()
+        settles_before = stats.cdcl_settles
+        memory_before = stats.memory_hits + stats.quick_sat_hits
+        try:
+            maybe_inject("serve.request")
+            issues, exceptions = self._analyzer._analyze_one_contract(
+                request.contract, request.modules or self.modules,
+                request.tx_count or self.tx_count, stats=stats)
+            report = Report(contracts=[request.contract],
+                            exceptions=exceptions)
+            for issue in issues:
+                report.append_issue(issue)
+            resolved = request.resolve({
+                "status": "ok",
+                "issues": json.loads(report.as_json())["issues"],
+                "exceptions": list(exceptions),
+                "origin": request.origin,
+                # per-request settle/memo deltas (exact for width-1
+                # batches; interleaved siblings' settles fold in for
+                # mixed ones — still the warm-vs-cold signal)
+                "cdcl_settles": stats.cdcl_settles - settles_before,
+                "memo_hits": (stats.memory_hits + stats.quick_sat_hits
+                              - memory_before),
+            })
+            if resolved:
+                stats.add_serve_outcome("completed")
+        except Exception as error:
+            resilience.record_event("serve.request", "quarantine")
+            log.warning("request %d (tenant %s) poisoned: %r — failing "
+                        "it alone", request.request_id, request.tenant,
+                        error)
+            if request.resolve({"status": "error",
+                                "reason": repr(error)}):
+                stats.add_serve_outcome("completed")
+
+    def _requeue_or_incomplete(self, batch: List[ServeRequest],
+                               reason: str) -> None:
+        """Batch-level failure disposition: every UNFINISHED request goes
+        around once more (fresh batch, fresh runner); a request that
+        already failed a batch answers `incomplete` — the typed
+        never-hung guarantee. Finished siblings keep their results."""
+        from mythril_tpu.resilience import record_event
+        from mythril_tpu.smt.solver.statistics import SolverStatistics
+
+        stats = SolverStatistics()
+        for request in batch:
+            if request.done:
+                continue
+            if request.requeues == 0 and not self._stopping:
+                request.requeues += 1
+                record_event("serve.worker", "worker_requeue")
+                stats.add_serve_outcome("requeued")
+                with self._cv:
+                    self._inflight.remove(request)
+                    self._queue.insert(0, request)
+                    self._cv.notify_all()
+            elif request.resolve({"status": "incomplete",
+                                  "reason": reason}):
+                record_event("serve.worker", "degraded")
+                stats.add_serve_outcome("incomplete")
+
+    @staticmethod
+    def _teardown_batch() -> None:
+        """Request-teardown unwind (the PR-12 _flush_safely
+        finally-resolution generalized): an abandoned batch may have
+        left queries parked in the process-global coalescing window —
+        resolve every buffered handle (to unknown) so nothing the next
+        batch does can hang on a handle nobody will ever flush."""
+        from mythril_tpu.service.scheduler import get_scheduler
+
+        scheduler = get_scheduler()
+        if scheduler.pending():
+            log.warning("unwinding %d parked scheduler handle(s) from "
+                        "an abandoned serve batch", scheduler.pending())
+            scheduler.clear()
+
+    # -- eviction ------------------------------------------------------------
+
+    def evict_tenant(self, tenant: str, wait_timeout: float = 60.0
+                     ) -> bool:
+        """Session-scoped invalidation: drop ONE tenant's warm memos
+        (memory tiers, quick-sat deques, private blasters, prefix
+        snapshots) without flushing the shared strash table, the disk
+        tier, or any other tenant's warmth. Waits for the tenant's OWN
+        queued/in-flight requests to finish first — evicting under a
+        live context would let the context's save/restore reinstall the
+        supposedly-evicted memos. Returns False if the tenant stayed
+        busy past the wait (nothing evicted; retry later)."""
+        from mythril_tpu.service.tenancy import encode_session
+        from mythril_tpu.support.model import clear_caches
+
+        from mythril_tpu.service.tenancy import origin_in_session
+
+        session = encode_session(tenant)
+        deadline = time.monotonic() + wait_timeout
+        with self._cv:
+            while any(origin_in_session(request.origin, session)
+                      for request in self._queue + self._inflight):
+                if time.monotonic() >= deadline:
+                    return False
+                self._cv.wait(0.2)
+            # close the admission window BEFORE releasing the lock: a
+            # same-tenant submit landing between the emptiness check
+            # and the clear would run a live context during eviction
+            self._evicting.add(session)
+        try:
+            clear_caches(session=session)
+        finally:
+            with self._cv:
+                self._evicting.discard(session)
+                self._cv.notify_all()
+        return True
+
+    # -- drain ---------------------------------------------------------------
+
+    def healthz(self) -> dict:
+        from mythril_tpu.smt.solver.statistics import SolverStatistics
+
+        stats = SolverStatistics()
+        with self._cv:
+            queued, inflight = len(self._queue), len(self._inflight)
+            draining = self._draining or self._stopping
+        return {
+            "status": "draining" if draining else "ok",
+            "queued": queued,
+            "in_flight": inflight,
+            "queue_max": self.queue_max,
+            "requests": {
+                "admitted": stats.serve_requests_admitted,
+                "rejected": stats.serve_requests_rejected,
+                "requeued": stats.serve_requests_requeued,
+                "incomplete": stats.serve_requests_incomplete,
+                "completed": stats.serve_requests_completed,
+            },
+        }
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Graceful shutdown: stop admitting, finish everything already
+        admitted, write the final reconciled heartbeat, stop the
+        listener. Returns True on a clean drain; on timeout the
+        leftovers answer `incomplete` (answered, never hung) and False
+        comes back."""
+        from mythril_tpu.smt.solver.statistics import SolverStatistics
+
+        start = time.monotonic()
+        budget = timeout if timeout is not None else self.drain_timeout_s
+        with self._cv:
+            self._draining = True
+            self._cv.notify_all()
+        clean = True
+        with self._cv:
+            while self._queue or self._inflight:
+                if time.monotonic() - start >= budget:
+                    clean = False
+                    break
+                self._cv.wait(0.2)
+            self._stopping = True
+            self._cv.notify_all()
+        if not clean:
+            with self._cv:
+                leftovers = list(self._queue) + list(self._inflight)
+                self._queue.clear()
+            stats = SolverStatistics()
+            for request in leftovers:
+                if request.resolve({"status": "incomplete",
+                                    "reason": "drain timeout"}):
+                    stats.add_serve_outcome("incomplete")
+        if self._worker is not None:
+            self._worker.join(timeout=5.0)
+        SolverStatistics().add_serve_drain_seconds(
+            time.monotonic() - start)
+        if self._heartbeat is not None:
+            self._heartbeat.stop(final=True)
+            self._heartbeat = None
+        if self._http is not None:
+            self._http.stop()
+            self._http = None
+        self.drained.set()
+        log.info("serve daemon drained in %.2fs (clean=%s)",
+                 time.monotonic() - start, clean)
+        return clean
+
+
+def install_signal_handlers(daemon: ServeDaemon) -> None:
+    """SIGTERM/SIGINT -> graceful drain (main thread only)."""
+    import signal
+
+    def _handler(_signum, _frame):
+        threading.Thread(target=daemon.drain, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _handler)
+    signal.signal(signal.SIGINT, _handler)
+
+
+def serve_forever(daemon: ServeDaemon) -> int:
+    """CLI entry: start, announce the endpoints, block until drained."""
+    daemon.start()
+    install_signal_handlers(daemon)
+    print(f"mythril_tpu serve listening on http://127.0.0.1:{daemon.port}"
+          f" (POST /analyze, POST /evict, GET /healthz, GET /metrics);"
+          f" SIGTERM drains", flush=True)
+    daemon.drained.wait()
+    return 0

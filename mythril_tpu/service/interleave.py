@@ -40,18 +40,24 @@ in time. This module is that driver's machinery:
                interleaved run's findings are byte-identical to the
                sequential (interleave=1) run's.
 
+The per-origin context-switch machinery itself (EngineContext, the
+private blaster registry, session eviction) lives in
+service/tenancy.py — one implementation shared with the serve daemon's
+cross-request batcher, so the two drivers cannot drift.
+
 Knobs: MYTHRIL_TPU_CORPUS_INTERLEAVE / --corpus-interleave selects the
 driver (core.MythrilAnalyzer._fire_lasers_interleaved);
 MYTHRIL_TPU_INTERLEAVE_QUANTUM sets the exec iterations per turn.
 """
 
-import copy
 import logging
 import threading
-import time
 from collections import deque
 from contextlib import contextmanager
 from typing import List, Optional
+
+from mythril_tpu.service import tenancy
+from mythril_tpu.service.tenancy import EngineContext as _EngineContext
 
 log = logging.getLogger(__name__)
 
@@ -59,15 +65,15 @@ DEFAULT_QUANTUM = 16  # exec-loop iterations per baton turn
 
 _active: Optional["Coordinator"] = None
 
-# origin -> (Blaster or None, term generation): each contract's private
-# blaster/AIG. The shared strashed AIG assigns node ids in first-use
-# order and the dense CNF sorts by id, so a process-wide blaster makes
-# the CDCL's branching — and hence which valid witness model it returns
-# — depend on which sibling contract blasted a common subterm first.
-# Per-origin blasters reproduce the solo-process id space exactly: the
-# property that makes interleaved findings BYTE-identical to the
-# sequential schedule. (None = lazily recreated on first use.)
-_blasters: dict = {}
+
+class BatchCancelled(BaseException):
+    """Raised inside an ABANDONED analysis thread at its next yield
+    point after its coordinator was cancelled (the serve daemon's
+    deadline kill). BaseException on purpose: it must cut straight
+    through the engine's per-contract `except Exception` capture — an
+    abandoned thread's analysis must die, not be recorded as a
+    contract-level failure racing the requeued batch over the engine
+    globals."""
 
 
 def active() -> Optional["Coordinator"]:
@@ -83,29 +89,26 @@ def current_origin() -> Optional[str]:
     return coordinator._current_origin if coordinator is not None else None
 
 
+# the slot thread's OWN coordinator (set at attach, cleared at detach):
+# an abandoned thread must die at its next tick even when its cancelled
+# coordinator is no longer installed — the global _active alone cannot
+# tell an abandoned thread from the main thread
+_thread_coordinator = threading.local()
+
+
 def tick() -> None:
     """Exec-loop yield point (laser/svm.py): hand the baton to the next
-    runnable analysis every `quantum` iterations. One global load + a
-    None check when no coordinator is live — the cost discipline every
-    always-on crossing in this codebase follows."""
+    runnable analysis every `quantum` iterations. One thread-local +
+    one global load and a None check when no coordinator is live — the
+    cost discipline every always-on crossing in this codebase
+    follows."""
+    own = getattr(_thread_coordinator, "value", None)
+    if own is not None:
+        own.maybe_switch()
+        return
     coordinator = _active
     if coordinator is not None:
         coordinator.maybe_switch()
-
-
-def _install_blaster(origin) -> None:
-    from mythril_tpu.smt.solver import frontend
-
-    (frontend._global_blaster,
-     frontend._global_blaster_generation) = _blasters.get(origin,
-                                                          (None, -1))
-
-
-def _stash_blaster(origin) -> None:
-    from mythril_tpu.smt.solver import frontend
-
-    _blasters[origin] = (frontend._global_blaster,
-                         frontend._global_blaster_generation)
 
 
 @contextmanager
@@ -123,137 +126,13 @@ def blaster_scope(origin):
     from mythril_tpu.smt.solver import frontend
 
     saved = (frontend._global_blaster, frontend._global_blaster_generation)
-    _install_blaster(origin)
+    tenancy.install_blaster(origin)
     try:
         yield
     finally:
-        _stash_blaster(origin)
+        tenancy.stash_blaster(origin)
         (frontend._global_blaster,
          frontend._global_blaster_generation) = saved
-
-
-class _EngineContext:
-    """One origin's slice of the process-global engine state.
-
-    install_fresh() gives a starting analysis pristine state (the same
-    state a solo-process analysis of the contract would see); save()
-    captures the live globals when the origin loses the baton; restore()
-    reinstalls them when it gets the baton back. State swapped by
-    object-identity-preserving `__dict__` replacement where the global
-    is a singleton other modules hold references to (function managers,
-    detection modules), and by module-attribute rebinding where call
-    sites re-read the attribute (support.model's memory tiers)."""
-
-    def __init__(self, origin: str, module_templates):
-        self.origin = origin
-        self._templates = module_templates
-        self._saved = None
-
-    @staticmethod
-    def capture_module_templates():
-        """Pristine per-module state snapshots, taken once at driver
-        start (right after core.fire_lasers reset every module): each
-        origin's fresh install copies from these, so a module attribute
-        added mid-run by one origin can never leak into another's."""
-        from mythril_tpu.analysis.module import ModuleLoader
-
-        return [
-            (module, {key: copy.copy(value)
-                      for key, value in module.__dict__.items()})
-            for module in ModuleLoader().get_detection_modules()
-        ]
-
-    def install_fresh(self) -> None:
-        from mythril_tpu.laser.function_managers import (
-            exponent_function_manager,
-            keccak_function_manager,
-        )
-        from mythril_tpu.laser.transaction.models import tx_id_manager
-        from mythril_tpu.smt.solver import frontend
-        from mythril_tpu.support import model as model_mod
-        from mythril_tpu.support.time_handler import time_handler
-
-        time_handler._start = None
-        time_handler._timeout = None
-        tx_id_manager._next = 0
-        # fresh per-origin blaster (see the _blasters registry note): a
-        # starting contract gets an empty AIG, exactly like a solo
-        # process (None = lazily recreated on first use)
-        _blasters[self.origin] = (None, -1)
-        frontend._global_blaster = None
-        frontend._global_blaster_generation = -1
-        keccak_function_manager.__dict__ = (
-            type(keccak_function_manager)().__dict__)
-        exponent_function_manager.__dict__ = (
-            type(exponent_function_manager)().__dict__)
-        for module, template in self._templates:
-            module.__dict__ = {key: copy.copy(value)
-                               for key, value in template.items()}
-        # the origin's memory tiers live in model.py's per-origin
-        # registry (get_models_batch resolves them PER QUERY during
-        # mixed flushes); installing them into the module globals serves
-        # the ambient call sites — get_model, the engine's direct
-        # quick-sat probes — while this origin holds the baton. Starting
-        # a contract drops any stale registry pair so each analysis
-        # starts as cold as a solo process would.
-        model_mod._origin_caches.pop(self.origin, None)
-        tier, quick_cache = model_mod.caches_for_origin(self.origin)
-        model_mod._result_cache = tier
-        model_mod.model_cache = quick_cache
-        model_mod._in_detection_context = False
-
-    def save(self) -> None:
-        from mythril_tpu.laser.function_managers import (
-            exponent_function_manager,
-            keccak_function_manager,
-        )
-        from mythril_tpu.laser.transaction.models import tx_id_manager
-        from mythril_tpu.support import model as model_mod
-        from mythril_tpu.support.time_handler import time_handler
-
-        # the execution-timeout clock PAUSES while the origin is
-        # off-baton: store elapsed-so-far, not the absolute start, so a
-        # contract's budget measures its own engine time — siblings'
-        # quanta must not burn it (and must not make the interleaved
-        # run's timeout behavior diverge from the sequential run's)
-        elapsed = (time.monotonic() - time_handler._start
-                   if time_handler._start is not None else None)
-        _stash_blaster(self.origin)
-        self._saved = {
-            "time": (elapsed, time_handler._timeout),
-            "txid": tx_id_manager._next,
-            "keccak": keccak_function_manager.__dict__,
-            "exponent": exponent_function_manager.__dict__,
-            "modules": [module.__dict__ for module, _t in self._templates],
-            "result_cache": model_mod._result_cache,
-            "model_cache": model_mod.model_cache,
-            "detection": model_mod._in_detection_context,
-        }
-
-    def restore(self) -> None:
-        from mythril_tpu.laser.function_managers import (
-            exponent_function_manager,
-            keccak_function_manager,
-        )
-        from mythril_tpu.laser.transaction.models import tx_id_manager
-        from mythril_tpu.support import model as model_mod
-        from mythril_tpu.support.time_handler import time_handler
-
-        saved = self._saved
-        self._saved = None
-        elapsed, timeout = saved["time"]
-        time_handler._timeout = timeout
-        time_handler._start = (time.monotonic() - elapsed
-                               if elapsed is not None else None)
-        tx_id_manager._next = saved["txid"]
-        _install_blaster(self.origin)
-        keccak_function_manager.__dict__ = saved["keccak"]
-        exponent_function_manager.__dict__ = saved["exponent"]
-        for (module, _t), state in zip(self._templates, saved["modules"]):
-            module.__dict__ = state
-        model_mod._result_cache = saved["result_cache"]
-        model_mod.model_cache = saved["model_cache"]
-        model_mod._in_detection_context = saved["detection"]
 
 
 class Coordinator:
@@ -266,16 +145,32 @@ class Coordinator:
     the new holder has not started), so the swap itself needs no extra
     locking."""
 
-    def __init__(self, tasks, quantum: Optional[int] = None):
+    def __init__(self, tasks, quantum: Optional[int] = None,
+                 origins: Optional[List[str]] = None, warm: bool = False,
+                 module_templates=None):
         """`tasks`: list of (idx, contract) in corpus order. Origin tags
         are minted here (index-qualified — corpus contracts loaded from
-        bytecode all share the name MAIN)."""
+        bytecode all share the name MAIN) unless the caller supplies its
+        own `origins` (parallel to `tasks` — the serve daemon mints
+        tenant-qualified tags). `warm=True` preserves each origin's
+        solve memos across runs (EngineContext.install_fresh
+        preserve_caches — the serve daemon's cross-request reuse);
+        `module_templates` reuses a caller-captured pristine module
+        snapshot instead of capturing at construction (the serve daemon
+        captures ONCE at startup so batch N's templates cannot carry
+        batch N-1's module state)."""
         from mythril_tpu.support.env import env_float as _env_float
 
         self._cond = threading.Condition()
-        self._tasks = deque(
-            (idx, contract, f"{idx}:{getattr(contract, 'name', '?')}")
-            for idx, contract in tasks)
+        self._warm = warm
+        if origins is not None:
+            self._tasks = deque(
+                (idx, contract, origin)
+                for (idx, contract), origin in zip(tasks, origins))
+        else:
+            self._tasks = deque(
+                (idx, contract, f"{idx}:{getattr(contract, 'name', '?')}")
+                for idx, contract in tasks)
         self._waitq: deque = deque()
         self._live = set()
         self._current: Optional[int] = None
@@ -285,11 +180,14 @@ class Coordinator:
         self._tls = threading.local()
         self._current_origin: Optional[str] = None
         self._ticks = 0
+        self._cancelled = False
         self.quantum = max(1, int(quantum if quantum is not None
                                   else _env_float(
                                       "MYTHRIL_TPU_INTERLEAVE_QUANTUM",
                                       DEFAULT_QUANTUM)))
-        self._module_templates = _EngineContext.capture_module_templates()
+        self._module_templates = (module_templates if module_templates
+                                  is not None
+                                  else tenancy.capture_module_templates())
         # the pre-driver module globals, restored by uninstall() so the
         # process's later origin-less work sees its own caches again
         from mythril_tpu.support import model as model_mod
@@ -315,12 +213,22 @@ class Coordinator:
                 context = _EngineContext(origin, self._module_templates)
                 with self._cond:
                     self._contexts[slot_id] = context
-                context.install_fresh()
+                context.install_fresh(preserve_caches=self._warm)
                 self._current_origin = origin
                 self._ticks = 0
                 try:
                     analyze_one(idx, contract)
                 finally:
+                    if self._warm and not self._cancelled:
+                        # warm drivers (serve): the origin's final
+                        # blaster state must survive task completion —
+                        # handoffs stash it, but the LAST holder exits
+                        # here without one. NEVER on cancellation: a
+                        # slot unwinding from an off-baton wait would
+                        # stash whichever SIBLING origin's blaster is
+                        # live in the globals under ITS origin —
+                        # cross-tenant id-space poisoning
+                        tenancy.stash_blaster(origin)
                     with self._cond:
                         self._contexts[slot_id] = None
                     self._current_origin = None
@@ -332,6 +240,7 @@ class Coordinator:
 
     def _attach(self, slot_id: int) -> None:
         self._tls.slot = slot_id
+        _thread_coordinator.value = self
         with self._cond:
             self._live.add(slot_id)
             if self._current is None:
@@ -339,10 +248,13 @@ class Coordinator:
                 return
             self._waitq.append(slot_id)
             while self._current != slot_id:
+                self._check_cancelled()
                 self._cond.wait()
+            self._check_cancelled()
             self._restore(slot_id)
 
     def _detach(self, slot_id: int) -> None:
+        _thread_coordinator.value = None
         with self._cond:
             self._live.discard(slot_id)
             self._wants_flush.discard(slot_id)
@@ -388,7 +300,9 @@ class Coordinator:
             self._current = next_id
             self._cond.notify_all()
             while self._current != me:
+                self._check_cancelled()
                 self._cond.wait()
+            self._check_cancelled()
             self._restore(me)
         return True
 
@@ -407,10 +321,32 @@ class Coordinator:
             self._current_origin = None
         self._ticks = 0
 
+    def cancel(self) -> None:
+        """Abandon every slot thread: each raises BatchCancelled at its
+        next yield point (quantum tick, handoff wait, or solve park).
+        The serve daemon calls this when a batch blows its hard
+        deadline, so the abandoned threads stop mutating the engine
+        globals instead of racing the requeued batch over them."""
+        with self._cond:
+            self._cancelled = True
+            self._cond.notify_all()
+
+    def _check_cancelled(self) -> None:
+        if self._cancelled:
+            raise BatchCancelled(
+                "this analysis batch was abandoned by its driver")
+
     def maybe_switch(self) -> None:
         """Quantum yield point (module-level tick()). Only the baton
         holder executes engine code, so no lock is needed for the tick
-        counter itself."""
+        counter itself. A thread with NO slot on this coordinator is an
+        abandoned sibling from a cancelled predecessor still running
+        engine code — it dies here, before it can touch the handoff
+        machinery it never attached to."""
+        self._check_cancelled()
+        if getattr(self._tls, "slot", None) is None:
+            raise BatchCancelled(
+                "engine thread is not a slot of the live coordinator")
         self._ticks += 1
         if self._ticks < self.quantum:
             return
@@ -430,6 +366,7 @@ class Coordinator:
         launch."""
         me = self._tls.slot
         while True:
+            self._check_cancelled()
             if all(handle.done for handle in handles):
                 return
             with self._cond:
@@ -467,15 +404,32 @@ class Coordinator:
             scheduler.clear()
 
 
+_install_lock = threading.Lock()
+
+
 def install(coordinator: Coordinator) -> None:
     global _active
-    _active = coordinator
+    with _install_lock:
+        _active = coordinator
 
 
-def uninstall() -> None:
+def uninstall(keep_tenancy: bool = False,
+              expected: Optional[Coordinator] = None) -> None:
+    """Tear the coordinator down. `keep_tenancy=True` (the serve daemon,
+    between request batches) keeps the per-origin blaster registry and
+    memory tiers alive so the next batch starts WARM; the corpus driver
+    clears them — its origins never recur. `expected` makes the
+    teardown a compare-and-swap: an ABANDONED batch body unwinding late
+    must not pop a successor batch's freshly installed coordinator (the
+    check and the swap are atomic under one lock — a bare is-active
+    check before calling would race the successor's install)."""
     global _active
-    coordinator, _active = _active, None
-    _blasters.clear()
+    with _install_lock:
+        if expected is not None and _active is not expected:
+            return
+        coordinator, _active = _active, None
+    if not keep_tenancy:
+        tenancy.clear_blasters()
     if coordinator is None:
         return
     from mythril_tpu.smt.solver import frontend

@@ -1,0 +1,282 @@
+"""Per-origin engine-context registry — the tenancy layer shared by the
+two multi-analysis drivers (`--corpus-interleave` and `mythril_tpu
+serve`).
+
+PR 12 built this machinery inside service/interleave.py, reachable only
+through the corpus driver's entry point; the serve daemon needs the SAME
+context-switch discipline for its per-tenant request batches, and two
+private copies would drift (the exact bug class the isolation audit
+exists to catch). This module is the single home for:
+
+  origins      an origin tag is one analysis's identity: for the corpus
+               driver `"{idx}:{basename}"`, for the serve daemon
+               `"{tenant}:{code digest}"` — ALWAYS tenant/slot-qualified,
+               never a bare contract basename, so two tenants submitting
+               files that happen to share a name can never share a
+               memory tier, a quick-sat deque, or a blaster id space.
+               `origin_in_session(origin, session)` is the one predicate
+               that maps origins back to their owning session/tenant
+               (eviction, isolation audits).
+  blasters     the per-origin private blaster/AIG registry: the shared
+               strashed AIG assigns node ids in first-use order and the
+               dense CNF sorts by id, so a process-wide blaster makes
+               the CDCL's branching — and hence which valid witness
+               model it returns — depend on which sibling analysis
+               blasted a common subterm first. Per-origin blasters
+               reproduce the solo-process id space exactly: the property
+               that makes interleaved/served findings BYTE-identical to
+               the solo schedule, witnesses included.
+  EngineContext  one origin's slice of the process-global engine state
+               (wall budget, tx ids, keccak/exponent managers, module
+               issue state, memory/quick-sat solve tiers, detection
+               flag), context-switched at every baton handoff.
+               install_fresh(preserve_caches=True) is the serve daemon's
+               WARM start: engine state (modules, tx ids, clocks) resets
+               per request, but the origin's solve memos — memory tier,
+               quick-sat deque, private blaster AIG — survive across
+               requests, which is what makes a repeat request on a warm
+               daemon record strictly fewer cdcl_settles.
+  eviction     evict_session(session): drop ONE session's origins —
+               memory tiers, quick-sat deques, blasters, and its prefix
+               snapshots (smt/solver/incremental.py) — without flushing
+               the shared strash table, the disk tier, or any other
+               tenant's warmth (the all-or-nothing clear_caches would
+               cold-start every tenant on any one tenant's
+               invalidation).
+"""
+
+import copy
+import time
+from typing import Dict, Optional, Tuple
+
+# origin -> (Blaster or None, term generation): each analysis's private
+# blaster/AIG (None = lazily recreated on first use).
+_blasters: Dict[str, Tuple[object, int]] = {}
+
+
+def encode_session(session: str) -> str:
+    """Injective colon-free encoding of an arbitrary session/tenant id.
+    Origins are minted as "<session>:<qualifier>" and
+    origin_in_session() splits on the FIRST colon, so a raw tenant id
+    containing ':' (they arrive from HTTP bodies) would let tenant
+    "alice" evict "alice:prod"'s memos — the exact cross-tenant reach
+    the predicate exists to forbid. Percent-escaping keeps distinct ids
+    distinct."""
+    return str(session).replace("%", "%25").replace(":", "%3A")
+
+
+def origin_in_session(origin: Optional[str], session: str) -> bool:
+    """Does `origin` belong to `session` (an encode_session()-ed tenant
+    id, a raw colon-free one, or a full origin tag)? Origins are minted
+    as "<session>:<qualifier>", so the owning session is everything
+    before the first colon; an exact match accepts a full origin tag as
+    its own session."""
+    if origin is None:
+        return False
+    return origin == session or origin.split(":", 1)[0] == session
+
+
+def install_blaster(origin) -> None:
+    """Install `origin`'s private blaster over the process globals."""
+    from mythril_tpu.smt.solver import frontend
+
+    (frontend._global_blaster,
+     frontend._global_blaster_generation) = _blasters.get(origin,
+                                                          (None, -1))
+
+
+def stash_blaster(origin) -> None:
+    """Capture the live process-global blaster as `origin`'s."""
+    from mythril_tpu.smt.solver import frontend
+
+    _blasters[origin] = (frontend._global_blaster,
+                         frontend._global_blaster_generation)
+
+
+def reset_blaster(origin) -> None:
+    """Give `origin` an empty blaster (cold start), installing it."""
+    from mythril_tpu.smt.solver import frontend
+
+    _blasters[origin] = (None, -1)
+    frontend._global_blaster = None
+    frontend._global_blaster_generation = -1
+
+
+def clear_blasters() -> None:
+    _blasters.clear()
+
+
+def capture_module_templates():
+    """Pristine per-module state snapshots, taken once at driver start
+    (right after every module was reset): each origin's fresh install
+    copies from these, so a module attribute added mid-run by one origin
+    can never leak into another's."""
+    from mythril_tpu.analysis.module import ModuleLoader
+
+    return [
+        (module, {key: copy.copy(value)
+                  for key, value in module.__dict__.items()})
+        for module in ModuleLoader().get_detection_modules()
+    ]
+
+
+class EngineContext:
+    """One origin's slice of the process-global engine state.
+
+    install_fresh() gives a starting analysis pristine engine state (the
+    same state a solo-process analysis of the contract would see);
+    save() captures the live globals when the origin loses the baton;
+    restore() reinstalls them when it gets the baton back. State swapped
+    by object-identity-preserving `__dict__` replacement where the
+    global is a singleton other modules hold references to (function
+    managers, detection modules), and by module-attribute rebinding
+    where call sites re-read the attribute (support.model's memory
+    tiers).
+
+    `preserve_caches=True` (the serve daemon's warm start) keeps the
+    origin's existing solve memos — memory tier, quick-sat deque, and
+    private blaster — across requests; the engine state (clocks, tx
+    ids, keccak/exponent managers, module issue lists) still resets per
+    request, exactly as a fresh solo analysis would see it."""
+
+    def __init__(self, origin: str, module_templates):
+        self.origin = origin
+        self._templates = module_templates
+        self._saved = None
+
+    def install_fresh(self, preserve_caches: bool = False) -> None:
+        from mythril_tpu.laser.function_managers import (
+            exponent_function_manager,
+            keccak_function_manager,
+        )
+        from mythril_tpu.laser.transaction.models import tx_id_manager
+        from mythril_tpu.support import model as model_mod
+        from mythril_tpu.support.time_handler import time_handler
+
+        time_handler._start = None
+        time_handler._timeout = None
+        tx_id_manager._next = 0
+        if preserve_caches:
+            # warm start: the origin's private blaster (and below, its
+            # memory tiers) survive from its earlier requests — the
+            # cross-request memo reuse the serve daemon exists for
+            install_blaster(self.origin)
+        else:
+            # fresh per-origin blaster: a starting contract gets an
+            # empty AIG, exactly like a solo process
+            reset_blaster(self.origin)
+        keccak_function_manager.__dict__ = (
+            type(keccak_function_manager)().__dict__)
+        exponent_function_manager.__dict__ = (
+            type(exponent_function_manager)().__dict__)
+        for module, template in self._templates:
+            module.__dict__ = {key: copy.copy(value)
+                               for key, value in template.items()}
+        # the origin's memory tiers live in model.py's per-origin
+        # registry (get_models_batch resolves them PER QUERY during
+        # mixed flushes); installing them into the module globals serves
+        # the ambient call sites — get_model, the engine's direct
+        # quick-sat probes — while this origin holds the baton. A cold
+        # start drops any stale registry pair so the analysis starts as
+        # cold as a solo process would; a warm start keeps it.
+        if not preserve_caches:
+            model_mod._origin_caches.pop(self.origin, None)
+        tier, quick_cache = model_mod.caches_for_origin(self.origin)
+        model_mod._result_cache = tier
+        model_mod.model_cache = quick_cache
+        model_mod._in_detection_context = False
+
+    def save(self) -> None:
+        from mythril_tpu.laser.function_managers import (
+            exponent_function_manager,
+            keccak_function_manager,
+        )
+        from mythril_tpu.laser.transaction.models import tx_id_manager
+        from mythril_tpu.support import model as model_mod
+        from mythril_tpu.support.time_handler import time_handler
+
+        # the execution-timeout clock PAUSES while the origin is
+        # off-baton: store elapsed-so-far, not the absolute start, so a
+        # contract's budget measures its own engine time — siblings'
+        # quanta must not burn it (and must not make the interleaved
+        # run's timeout behavior diverge from the sequential run's)
+        elapsed = (time.monotonic() - time_handler._start
+                   if time_handler._start is not None else None)
+        stash_blaster(self.origin)
+        self._saved = {
+            "time": (elapsed, time_handler._timeout),
+            "txid": tx_id_manager._next,
+            "keccak": keccak_function_manager.__dict__,
+            "exponent": exponent_function_manager.__dict__,
+            "modules": [module.__dict__ for module, _t in self._templates],
+            "result_cache": model_mod._result_cache,
+            "model_cache": model_mod.model_cache,
+            "detection": model_mod._in_detection_context,
+        }
+
+    def restore(self) -> None:
+        from mythril_tpu.laser.function_managers import (
+            exponent_function_manager,
+            keccak_function_manager,
+        )
+        from mythril_tpu.laser.transaction.models import tx_id_manager
+        from mythril_tpu.support import model as model_mod
+        from mythril_tpu.support.time_handler import time_handler
+
+        saved = self._saved
+        self._saved = None
+        elapsed, timeout = saved["time"]
+        time_handler._timeout = timeout
+        time_handler._start = (time.monotonic() - elapsed
+                               if elapsed is not None else None)
+        tx_id_manager._next = saved["txid"]
+        install_blaster(self.origin)
+        keccak_function_manager.__dict__ = saved["keccak"]
+        exponent_function_manager.__dict__ = saved["exponent"]
+        for (module, _t), state in zip(self._templates, saved["modules"]):
+            module.__dict__ = state
+        model_mod._result_cache = saved["result_cache"]
+        model_mod.model_cache = saved["model_cache"]
+        model_mod._in_detection_context = saved["detection"]
+
+
+def evict_session(session: str) -> int:
+    """Session-scoped eviction: drop every memo belonging to ONE
+    session/tenant — its per-origin memory tiers and quick-sat deques,
+    its private blasters, and its prefix snapshots — WITHOUT flushing
+    the shared strash table, the disk tier, other tenants' tiers, the
+    scheduler, or the session fuses (the all-or-nothing clear_caches()
+    would cold-start every tenant on any one tenant's invalidation).
+    Returns the number of evicted origins."""
+    from collections import OrderedDict
+
+    from mythril_tpu.smt.solver import incremental
+    from mythril_tpu.support import model as model_mod
+
+    # iterate over SNAPSHOTS: eviction may run on an HTTP handler
+    # thread while another tenant's batch inserts fresh origins — a
+    # live-dict iteration would raise mid-eviction
+    doomed = [origin for origin in list(model_mod._origin_caches)
+              if origin_in_session(origin, session)]
+    for origin in doomed:
+        pair = model_mod._origin_caches.pop(origin, None)
+        if pair is None:
+            continue
+        tier, quick_cache = pair
+        # the evicted pair may be INSTALLED in the module globals (the
+        # session's context was live): replace with fresh empties so
+        # ambient call sites cannot keep serving the evicted memos
+        if model_mod._result_cache is tier:
+            model_mod._result_cache = OrderedDict()
+        if model_mod.model_cache is quick_cache:
+            model_mod.model_cache = model_mod.ModelCache()
+    for origin in [o for o in list(_blasters)
+                   if origin_in_session(o, session)]:
+        _blasters.pop(origin, None)
+        if origin not in doomed:
+            doomed.append(origin)
+    # the session's prefix snapshots (incremental prepare memos) go with
+    # it; the id-keyed simplify/free-symbol memos stay — they are
+    # content-addressed over the shared term table, not per-origin state
+    incremental.evict_session(session)
+    return len(doomed)

@@ -131,6 +131,20 @@ class SolverStatistics:
         # and deterministically injected faults (the chaos harness).
         # The per-site breakdown lives in resilience_events (emitted as
         # the stats JSON "resilience" section).
+        # serve daemon (mythril_tpu/serve/): request admission outcomes,
+        # cross-request batches (how many requests shared one
+        # interleaved batch and how many distinct tenants they came
+        # from — the per-tenant window share behind the
+        # serve_tenant_window_share gauge), deadline-killed requests
+        # requeued / answered incomplete, and completed requests
+        "serve_requests_admitted",
+        "serve_requests_rejected",
+        "serve_requests_requeued",
+        "serve_requests_incomplete",
+        "serve_requests_completed",
+        "serve_batches",
+        "serve_batch_requests",
+        "serve_batch_tenants",
         "resilience_retries",
         "resilience_breaker_trips",
         "resilience_breaker_probes",
@@ -187,6 +201,13 @@ class SolverStatistics:
         # seconds are INCLUDED: the fused step→solve round trip is
         # exactly what this stage times
         "frontier_fork_wall",
+        # serve daemon walls: summed queue wait of admitted requests
+        # (admission latency — the soak harness derives its p99 from
+        # per-request samples; this is the roll-up mean's numerator)
+        # and the SIGTERM drain (stop-admitting -> last in-flight
+        # request resolved -> final reconciled heartbeat written)
+        "serve_admission_wall",
+        "serve_drain_wall",
     )
 
     def __new__(cls):
@@ -584,6 +605,59 @@ class SolverStatistics:
                 record[0] += 1
                 record[1] += seconds
 
+    def add_serve_admission(self, admitted: bool) -> None:
+        """One serve-daemon admission decision: admitted into the
+        bounded queue, or rejected (`overloaded`/`draining` — the
+        explicit backpressure answer instead of unbounded latency)."""
+        if self.enabled:
+            if admitted:
+                self.serve_requests_admitted += 1
+            else:
+                self.serve_requests_rejected += 1
+
+    def add_serve_wait_seconds(self, seconds: float) -> None:
+        """Queue latency of one admitted request (submit -> its batch
+        popped): the admission-latency roll-up behind the soak
+        harness's per-request p99 samples."""
+        if self.enabled:
+            self.serve_admission_wall += seconds
+
+    def add_serve_batch(self, requests: int, tenants: int) -> None:
+        """One cross-request serve batch handed to the interleave
+        coordinator: `requests` admitted requests from `tenants`
+        distinct tenants share its coalescing windows."""
+        if self.enabled:
+            self.serve_batches += 1
+            self.serve_batch_requests += requests
+            self.serve_batch_tenants += tenants
+
+    def add_serve_outcome(self, outcome: str) -> None:
+        """Terminal disposition of one serve request: completed (a real
+        report, ok or error), requeued (deadline/worker fault — goes
+        around once more), or incomplete (second failure; answered,
+        never hung)."""
+        if self.enabled:
+            if outcome == "completed":
+                self.serve_requests_completed += 1
+            elif outcome == "requeued":
+                self.serve_requests_requeued += 1
+            elif outcome == "incomplete":
+                self.serve_requests_incomplete += 1
+
+    def add_serve_drain_seconds(self, seconds: float) -> None:
+        if self.enabled:
+            self.serve_drain_wall += seconds
+
+    @property
+    def serve_tenant_window_share(self) -> float:
+        """Mean requests each tenant contributed per serve batch — the
+        per-tenant share of a cross-request window (1.0 = every batch
+        held one request per tenant; higher = some tenant occupied more
+        of the shared window than its siblings)."""
+        if not self.serve_batch_tenants:
+            return 0.0
+        return self.serve_batch_requests / self.serve_batch_tenants
+
     @property
     def frontier_batch_occupancy(self) -> float:
         """Mean fraction of padded frontier batch slots holding live
@@ -639,6 +713,8 @@ class SolverStatistics:
         out["coalesce_occupancy"] = round(self.coalesce_occupancy, 4)
         out["frontier_batch_occupancy"] = round(
             self.frontier_batch_occupancy, 4)
+        out["serve_tenant_window_share"] = round(
+            self.serve_tenant_window_share, 4)
         out["prepare_suffix_hist"] = dict(self.prepare_suffix_hist)
         # the FULL per-opcode histogram is what absorb() merges across
         # --jobs workers (a top-10 slice silently dropped tail opcodes at

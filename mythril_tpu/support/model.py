@@ -781,11 +781,31 @@ def _store_result(key, value, cache=None) -> None:
         target.popitem(last=False)
 
 
-def clear_caches() -> None:
+def clear_caches(session: Optional[str] = None) -> None:
+    """Drop solve-cache state. With `session` given, the eviction is
+    SESSION-SCOPED (the serve daemon's per-tenant invalidation): only
+    that tenant's origins lose their memory tiers, quick-sat deques,
+    private blasters, and prefix snapshots — the shared session strash
+    table, the disk tier, the scheduler, other tenants' warmth, and the
+    resilience fuses are untouched, so one tenant's invalidation cannot
+    cold-start every other tenant. Without `session`, everything clears
+    (the historical all-or-nothing behavior tests and workers rely on)."""
+    if session is not None:
+        from mythril_tpu.service import tenancy
+
+        tenancy.evict_session(session)
+        return
     _result_cache.clear()
     model_cache.models.clear()
     _origin_caches.clear()
     _fingerprint_origins.clear()
+    # per-origin private blasters (service/tenancy.py): a full clear
+    # drops every tenant's AIG — the serve daemon's warm tiers do not
+    # survive a process-wide clear, only session-scoped eviction is
+    # selective
+    from mythril_tpu.service import tenancy
+
+    tenancy.clear_blasters()
     # service layer: buffered scheduler state is discarded and the
     # persistent-store handle released, so tests and --jobs workers start
     # clean — a cleared process re-populates from disk, not stale memory

@@ -28,3 +28,9 @@ if "xla_force_host_platform_device_count" not in xla_flags:
     ).strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_configure(config):
+    # tier-1 runs -m 'not slow'; the soak/long-haul tests opt out of it
+    config.addinivalue_line(
+        "markers", "slow: long-haul tests excluded from tier-1")

@@ -425,6 +425,166 @@ def test_worker_death_requeues_then_degrades_in_process(spec,
             f"{spec}: expected {event!r} at jobs.worker, got {recorded}")
 
 
+# -- serve daemon chaos (mythril_tpu/serve/) -----------------------------------
+#
+# The multi-tenant property the serve sites must hold ACROSS requests:
+# a fault injected mid-multi-tenant-serve degrades the faulted request
+# per its declared action while every OTHER tenant's findings stay
+# byte-identical (witnesses included) to the no-fault serve baseline.
+# device.dispatch and disk.entry are re-exercised THROUGH the daemon so
+# the per-invocation containment PR 8 proved is pinned per-request too.
+
+_SERVE_TENANTS = (("alice", KILLBILLY, 1), ("bob", OVERFLOW_ADD, 1))
+
+
+def _serve_run(spec=None, deadline_s=60.0):
+    """One 2-tenant daemon serve under the production fault-arming path
+    (args.inject_fault -> daemon.start). Returns {tenant: outcome}."""
+    from mythril_tpu.serve.daemon import ServeDaemon
+
+    global_args.inject_fault = spec
+    try:
+        daemon = ServeDaemon(tx_count=1, deadline_s=deadline_s).start()
+        try:
+            requests = [
+                (tenant, daemon.submit(tenant, wrap_creation(code),
+                                       tx_count=tx))
+                for tenant, code, tx in _SERVE_TENANTS
+            ]
+            outcomes = {tenant: request.wait(240.0)
+                        for tenant, request in requests}
+        finally:
+            assert daemon.drain(timeout=120.0), "serve drain hung"
+    finally:
+        global_args.inject_fault = None
+    for tenant, outcome in outcomes.items():
+        assert outcome is not None, f"{tenant}'s request never resolved"
+    return outcomes
+
+
+def _canonical_issues(issues, exact_witness: bool = True) -> str:
+    """The serve-outcome twin of _canonical (same masking rules)."""
+    issues = json.loads(json.dumps(issues))  # private copy
+    if not exact_witness:
+        for issue in issues:
+            sequence = issue.get("tx_sequence") or {}
+            for step in sequence.get("steps", ()):
+                step["input"] = f"<{len(step.get('input', ''))//2}B>"
+                step["value"] = "<witness>"
+    return json.dumps(
+        sorted(issues, key=lambda i: json.dumps(i, sort_keys=True)),
+        sort_keys=True)
+
+
+_SERVE_BASELINE = {}
+
+
+def _serve_baseline() -> dict:
+    """No-fault serve outcomes, computed once on fresh state: every
+    faulted serve run is compared against these bytes."""
+    if not _SERVE_BASELINE:
+        _full_reset()
+        _SERVE_BASELINE.update(_serve_run())
+        _full_reset()
+    return _SERVE_BASELINE
+
+
+# (spec, site, events that must reach the stats JSON, tenants whose
+# findings must be byte-identical, expected status of alice's request,
+# exact_witness). alice rides the FIRST crossing of n1 plans by
+# submission order; serve.worker faults fire at the BATCH level before
+# any engine state is touched, so even the requeued run's witnesses
+# reproduce exactly.
+SERVE_CHAOS_MATRIX = [
+    pytest.param("serve.request:raise:n1", "serve.request",
+                 ("injected", "quarantine"), ("bob",), "error", True,
+                 id="serve.request-raise"),
+    pytest.param("serve.admission:raise:n1", "serve.admission",
+                 ("injected", "degraded"), ("alice", "bob"), "ok", True,
+                 id="serve.admission-raise"),
+    pytest.param("serve.worker:raise:n1", "serve.worker",
+                 ("injected", "worker_requeue"), ("alice", "bob"), "ok",
+                 True, id="serve.worker-raise"),
+    pytest.param("serve.worker:hang:n1", "serve.worker",
+                 ("injected", "deadline", "worker_requeue"),
+                 ("alice", "bob"), "ok", True, id="serve.worker-hang"),
+    pytest.param("device.dispatch:raise:n1", "device.dispatch",
+                 ("injected",), ("alice", "bob"), "ok", False,
+                 id="serve-device.dispatch-raise"),
+]
+
+
+@pytest.mark.parametrize(
+    "spec,site,expected_events,identical_tenants,alice_status,"
+    "exact_witness", SERVE_CHAOS_MATRIX)
+def test_serve_fault_contains_to_one_request(spec, site, expected_events,
+                                             identical_tenants,
+                                             alice_status, exact_witness):
+    baseline = _serve_baseline()
+    _full_reset()
+    SolverStatistics().reset()
+    # a short deadline so the hang plan resolves via the runner-thread
+    # kill + requeue inside test time, not the 600 s injected sleep
+    faulted = _serve_run(spec=spec, deadline_s=6.0)
+    assert faulted["alice"]["status"] == alice_status
+    assert faulted["bob"]["status"] == "ok", \
+        "the other tenant must never notice the fault"
+    for tenant in identical_tenants:
+        assert _canonical_issues(faulted[tenant]["issues"],
+                                 exact_witness) == \
+            _canonical_issues(baseline[tenant]["issues"], exact_witness), \
+            f"{tenant}'s findings changed under injected fault {spec}"
+    recorded = _events(site)
+    for event in expected_events:
+        assert recorded.get(event, 0) >= 1, (
+            f"{spec}: expected {event!r} at {site} in the stats JSON "
+            f"resilience section, got {recorded}")
+
+
+def test_serve_worker_hang_bounded_and_requeued_once():
+    """The never-hung guarantee with a wall-clock witness: a wedged
+    serve worker is deadline-killed and the request completes via one
+    requeue — total wall bounded by deadlines + analysis, never by the
+    600 s injected sleep."""
+    _serve_baseline()
+    _full_reset()
+    SolverStatistics().reset()
+    start = time.monotonic()
+    outcomes = _serve_run(spec="serve.worker:hang:n1", deadline_s=4.0)
+    assert time.monotonic() - start < 90.0, \
+        "the injected hang leaked past the serve deadline"
+    assert outcomes["alice"]["status"] == "ok"
+    assert outcomes["bob"]["status"] == "ok"
+    stats = SolverStatistics()
+    assert stats.serve_requests_requeued >= 1
+    assert stats.resilience_deadline_trips >= 1
+    assert stats.serve_requests_incomplete == 0, \
+        "one failure must requeue, not answer incomplete"
+
+
+def test_serve_corrupt_disk_entry_degrades_to_safe_miss_per_request():
+    """disk.entry chaos THROUGH the daemon: a warm persistent tier whose
+    entries are corrupted mid-serve must quarantine per lookup and
+    re-solve — every tenant's findings byte-identical to the no-fault
+    serve, with the poison never crossing tenants."""
+    baseline = _serve_baseline()
+    _full_reset()
+    populate = _serve_run()  # warm the disk tier through the daemon
+    for tenant in ("alice", "bob"):
+        assert _canonical_issues(populate[tenant]["issues"]) == \
+            _canonical_issues(baseline[tenant]["issues"])
+    _full_reset()  # drop memory tiers; the disk tier survives
+    SolverStatistics().reset()
+    faulted = _serve_run(spec="disk.entry:corrupt:*")
+    for tenant in ("alice", "bob"):
+        assert faulted[tenant]["status"] == "ok"
+        assert _canonical_issues(faulted[tenant]["issues"]) == \
+            _canonical_issues(baseline[tenant]["issues"]), \
+            f"{tenant}'s findings changed under corrupted disk entries"
+    recorded = _events("disk.entry")
+    assert recorded.get("quarantine", 0) >= 1, recorded
+
+
 # -- completion bound ----------------------------------------------------------
 
 

@@ -1,0 +1,560 @@
+"""`mythril_tpu serve` — the fault-contained multi-tenant daemon
+(mythril_tpu/serve/):
+
+  * admission — bounded queue + per-tenant budget answer `overloaded`
+    explicitly, a draining daemon answers `draining`, malformed input is
+    rejected at the door;
+  * batching — fair tenant round-robin (FIFO under a blown admission
+    fuse), same-origin requests never share a batch (their warm context
+    is one object);
+  * warmth — a repeat request on a warm daemon records strictly fewer
+    cdcl_settles (the cross-request memo reuse the daemon exists for)
+    and a crash-only restart re-warms from the persistent tiers;
+  * isolation — the cross-tenant memo audit: tenant-qualified origins,
+    disjoint memory tiers / quick-sat deques / blasters, no cross-tenant
+    memo visibility outside the content-addressed disk tier;
+  * eviction — clear_caches(session=...) drops ONE tenant's memos
+    (tiers, deques, blasters, prefix snapshots) without flushing the
+    shared strash table, the disk tier, or other tenants' warmth;
+  * lifecycle — /healthz + /metrics endpoints, graceful drain with the
+    final reconciled heartbeat, SIGTERM wiring.
+
+Serve CHAOS (the per-site degradation matrix under injected faults)
+lives in tests/test_chaos.py with the rest of the chaos suite.
+"""
+
+import json
+import os
+import signal
+import urllib.request
+
+import pytest
+
+from mythril_tpu import resilience
+from mythril_tpu.resilience import faults
+from mythril_tpu.serve.daemon import ServeDaemon
+from mythril_tpu.service import tenancy
+from mythril_tpu.smt.solver import incremental
+from mythril_tpu.smt.solver.statistics import SolverStatistics
+from mythril_tpu.support import model as model_mod
+from mythril_tpu.support.args import args as global_args
+
+from tests.test_analysis import KILLBILLY, OVERFLOW_ADD, wrap_creation
+
+
+@pytest.fixture(autouse=True)
+def serve_env():
+    from mythril_tpu import preanalysis
+    from mythril_tpu.tpu import router as router_mod
+
+    stats = SolverStatistics()
+    model_mod.clear_caches()
+    preanalysis.reset_caches()
+    router_mod.reset_router()
+    faults.configure(None)
+    stats.reset()
+    stats.enabled = True
+    saved_cache = global_args.solve_cache
+    yield
+    model_mod.clear_caches()
+    preanalysis.reset_caches()
+    router_mod.reset_router()
+    faults.configure(None)
+    global_args.inject_fault = None
+    global_args.heartbeat = None
+    global_args.solve_cache = saved_cache
+    stats.reset()
+
+
+def _drain(daemon):
+    assert daemon.drain(timeout=120.0)
+
+
+# -- admission ----------------------------------------------------------------
+
+
+def test_bounded_queue_rejects_overloaded():
+    """Backpressure is an explicit answer, not unbounded latency: with
+    the queue full the NEXT submit resolves `rejected: overloaded`
+    immediately (worker not started, so nothing drains)."""
+    daemon = ServeDaemon(queue_max=2, tenant_budget=8)
+    one = daemon.submit("a", wrap_creation(KILLBILLY))
+    two = daemon.submit("b", wrap_creation(OVERFLOW_ADD))
+    assert not one.done and not two.done  # admitted, queued
+    three = daemon.submit("c", wrap_creation(KILLBILLY))
+    assert three.done
+    assert three.outcome == {"status": "rejected", "reason": "overloaded",
+                             "request_id": three.request_id, "tenant": "c"}
+    stats = SolverStatistics()
+    assert stats.serve_requests_admitted == 2
+    assert stats.serve_requests_rejected == 1
+
+
+def test_per_tenant_budget_caps_one_tenants_queue_share():
+    """A flood tenant hears `overloaded` while its neighbor is still
+    admitted — one tenant cannot occupy the whole queue."""
+    daemon = ServeDaemon(queue_max=16, tenant_budget=2)
+    salted = [wrap_creation(KILLBILLY + b"\x00" * i) for i in range(3)]
+    assert not daemon.submit("flood", salted[0]).done
+    assert not daemon.submit("flood", salted[1]).done
+    third = daemon.submit("flood", salted[2])
+    assert third.outcome["reason"] == "overloaded"
+    # the small tenant still gets in
+    assert not daemon.submit("small", wrap_creation(OVERFLOW_ADD)).done
+
+
+def test_malformed_bytecode_rejected_at_admission():
+    daemon = ServeDaemon()
+    bad = daemon.submit("a", "zz-not-hex")
+    assert bad.done and bad.outcome["status"] == "rejected"
+    assert "bad request" in bad.outcome["reason"]
+
+
+def test_draining_daemon_rejects_new_requests():
+    daemon = ServeDaemon()
+    daemon._draining = True
+    late = daemon.submit("a", wrap_creation(KILLBILLY))
+    assert late.outcome == {"status": "rejected", "reason": "draining",
+                            "request_id": late.request_id, "tenant": "a"}
+
+
+# -- batching -----------------------------------------------------------------
+
+
+def test_fair_batching_round_robins_tenants():
+    """Three queued requests from a flood tenant + one from a small
+    tenant, batch width 2: the batch holds ONE of each — arrival order
+    within a tenant preserved, no tenant monopolizing the window."""
+    daemon = ServeDaemon(batch_max=2, tenant_budget=8)
+    flood = [daemon.submit("flood", wrap_creation(KILLBILLY + b"\x00" * i))
+             for i in range(3)]
+    small = daemon.submit("small", wrap_creation(OVERFLOW_ADD))
+    with daemon._cv:
+        batch = daemon._next_batch()
+    assert [r.tenant for r in batch] == ["flood", "small"]
+    assert batch[0] is flood[0] and batch[1] is small
+
+
+def test_blown_admission_fuse_degrades_to_fifo():
+    """With the serve.admission session fuse blown, batching is plain
+    FIFO — requests reordered never dropped (the declared disable
+    degradation, reachable without injection)."""
+    daemon = ServeDaemon(batch_max=2, tenant_budget=8)
+    first = daemon.submit("flood", wrap_creation(KILLBILLY))
+    second = daemon.submit("flood", wrap_creation(KILLBILLY + b"\x00\x00"))
+    daemon.submit("small", wrap_creation(OVERFLOW_ADD))
+    resilience.note_stage_failure("serve.admission", hard=True)
+    assert resilience.fuse_blown("serve.admission")
+    with daemon._cv:
+        batch = daemon._next_batch()
+    assert batch == [first, second]  # arrival order, tenant-blind
+
+
+def test_same_origin_requests_never_share_a_batch():
+    """Two requests for the SAME (tenant, bytecode) share one warm
+    context object — batching them together would context-switch one
+    origin against itself. They must ride separate batches."""
+    daemon = ServeDaemon(batch_max=4)
+    one = daemon.submit("a", wrap_creation(KILLBILLY))
+    two = daemon.submit("a", wrap_creation(KILLBILLY))
+    assert one.origin == two.origin
+    with daemon._cv:
+        first_batch = daemon._next_batch()
+        second_batch = daemon._next_batch()
+    assert first_batch == [one]
+    assert second_batch == [two]
+
+
+# -- end-to-end + cross-request warmth ---------------------------------------
+
+
+def _solo_issues(code_hex, tx_count=1):
+    """The solo-process oracle findings for one contract."""
+    from mythril_tpu.core import MythrilAnalyzer, MythrilDisassembler
+
+    disassembler = MythrilDisassembler()
+    disassembler.load_from_bytecode(code_hex)
+    analyzer = MythrilAnalyzer(disassembler, strategy="bfs")
+    report = analyzer.fire_lasers(transaction_count=tx_count)
+    return sorted(json.dumps(i, sort_keys=True)
+                  for i in json.loads(report.as_json())["issues"])
+
+
+def test_multi_tenant_batch_matches_solo_findings_and_warm_repeat():
+    """THE acceptance path: two tenants served from one daemon produce
+    findings byte-identical to solo-process runs (witnesses included —
+    per-origin blasters), and a repeat request on the warm daemon
+    records STRICTLY FEWER cdcl_settles with memo hits > 0 (cross-
+    request memo reuse demonstrated)."""
+    killbilly = wrap_creation(KILLBILLY)
+    overflow = wrap_creation(OVERFLOW_ADD)
+    solo_k = _solo_issues(killbilly)
+    solo_o = _solo_issues(overflow)
+    model_mod.clear_caches()
+
+    daemon = ServeDaemon(tx_count=1, deadline_s=120).start()
+    try:
+        alice = daemon.submit("alice", killbilly)
+        bob = daemon.submit("bob", overflow)
+        out_a = alice.wait(240)
+        out_b = bob.wait(240)
+        assert out_a["status"] == "ok" and out_b["status"] == "ok"
+        assert sorted(json.dumps(i, sort_keys=True)
+                      for i in out_a["issues"]) == solo_k
+        assert sorted(json.dumps(i, sort_keys=True)
+                      for i in out_b["issues"]) == solo_o
+        assert out_a["cdcl_settles"] > 0, "vacuous warmth proves nothing"
+
+        warm = daemon.submit("alice", killbilly).wait(240)
+        assert warm["status"] == "ok"
+        assert sorted(json.dumps(i, sort_keys=True)
+                      for i in warm["issues"]) == solo_k
+        assert warm["cdcl_settles"] < out_a["cdcl_settles"], \
+            "a warm repeat must record strictly fewer CDCL settles"
+        assert warm["memo_hits"] > 0
+    finally:
+        _drain(daemon)
+    stats = SolverStatistics()
+    assert stats.serve_requests_completed == 3
+    assert stats.serve_batches >= 2
+    assert stats.serve_batch_tenants >= 2
+
+
+# -- cross-tenant memo isolation audit ---------------------------------------
+
+
+def test_origins_are_tenant_qualified_and_tiers_disjoint():
+    """The audit's structural half: origins embed the tenant, so two
+    tenants submitting the SAME bytes (or files sharing a basename) get
+    DISJOINT memory tiers, quick-sat deques, and blasters."""
+    code = wrap_creation(KILLBILLY)
+    one = ServeRequest_origin("alice", code)
+    two = ServeRequest_origin("bob", code)
+    assert one != two
+    assert one.split(":", 1)[0] == "alice"
+    tier_a, quick_a = model_mod.caches_for_origin(one)
+    tier_b, quick_b = model_mod.caches_for_origin(two)
+    assert tier_a is not tier_b
+    assert quick_a is not quick_b
+    assert tenancy.origin_in_session(one, "alice")
+    assert not tenancy.origin_in_session(one, "bob")
+
+
+def ServeRequest_origin(tenant, code):
+    from mythril_tpu.serve.daemon import ServeRequest
+
+    return ServeRequest(tenant, code).origin
+
+
+def test_no_cross_tenant_memo_visibility_without_disk_tier():
+    """The audit's behavioral half: with the disk tier OFF, tenant B
+    submitting the exact bytes tenant A just warmed gets ZERO memo hits
+    — A's constraint terms, witness bits, and memory-tier entries are
+    unreachable from B's probes (the only sanctioned cross-tenant reuse
+    path is the content-addressed, replay-verified disk tier). Findings
+    still agree: isolation costs no correctness."""
+    global_args.solve_cache = "memory"
+    code = wrap_creation(KILLBILLY)
+    daemon = ServeDaemon(tx_count=1, deadline_s=120).start()
+    try:
+        cold_a = daemon.submit("alice", code).wait(240)
+        warm_a = daemon.submit("alice", code).wait(240)
+        first_b = daemon.submit("bob", code).wait(240)
+        assert cold_a["status"] == warm_a["status"] == "ok"
+        assert first_b["status"] == "ok"
+        assert warm_a["memo_hits"] > 0, \
+            "same-tenant warmth must exist for the contrast to mean "\
+            "anything"
+        assert first_b["memo_hits"] == 0, \
+            "tenant B's probes observed tenant A's memo entries"
+        assert first_b["issues"] == cold_a["issues"]
+        # B's quick-sat deque never held A's witness models
+        _tier_a, quick_a = model_mod.caches_for_origin(cold_a["origin"])
+        _tier_b, quick_b = model_mod.caches_for_origin(first_b["origin"])
+        ids_b = {id(m) for m in quick_b.models}
+        assert not ids_b & {id(m) for m in quick_a.models}, \
+            "a witness model object is shared across tenant deques"
+    finally:
+        _drain(daemon)
+
+
+# -- session-scoped eviction --------------------------------------------------
+
+
+def test_evict_tenant_is_session_scoped():
+    """clear_caches(session=tenant) drops ONE tenant's memos — tiers,
+    deques, blasters, prefix snapshots — while the other tenant's
+    warmth, the shared strash session, and the scheduler survive."""
+    from mythril_tpu.preanalysis import aig_opt
+
+    code_a = wrap_creation(KILLBILLY)
+    code_b = wrap_creation(OVERFLOW_ADD)
+    daemon = ServeDaemon(tx_count=1, deadline_s=120).start()
+    try:
+        out_a = daemon.submit("alice", code_a).wait(240)
+        out_b = daemon.submit("bob", code_b).wait(240)
+        assert out_a["status"] == "ok" and out_b["status"] == "ok"
+        origin_a, origin_b = out_a["origin"], out_b["origin"]
+        assert origin_a in model_mod._origin_caches
+        assert origin_b in model_mod._origin_caches
+        assert tenancy._blasters.get(origin_a, (None,))[0] is not None
+        snapshots_before = incremental.snapshot_count()
+        alice_snapshots = incremental.snapshot_count("alice")
+        strash_before = aig_opt._session
+
+        daemon.evict_tenant("alice")
+
+        assert origin_a not in model_mod._origin_caches, \
+            "alice's memory tier must be gone"
+        assert origin_a not in tenancy._blasters
+        assert incremental.snapshot_count("alice") == 0
+        # bob's warmth and the shared layers survive
+        assert origin_b in model_mod._origin_caches
+        assert tenancy._blasters.get(origin_b) is not None
+        assert incremental.snapshot_count() == \
+            snapshots_before - alice_snapshots
+        assert aig_opt._session is strash_before, \
+            "the SHARED strash session must not flush on one tenant's "\
+            "eviction"
+        # evicted tenant comes back cold, and correct
+        cold_again = daemon.submit("alice", code_a).wait(240)
+        assert cold_again["status"] == "ok"
+        assert cold_again["memo_hits"] == 0
+        assert cold_again["issues"] == out_a["issues"]
+    finally:
+        _drain(daemon)
+
+
+# -- crash-only restart -------------------------------------------------------
+
+
+def test_crash_only_restart_rewarms_from_persistent_tier(tmp_path,
+                                                         monkeypatch):
+    """A restarted daemon holds none of its predecessor's memory — it
+    re-warms from the durable tiers: the second daemon's first request
+    records persistent hits and strictly fewer CDCL settles than the
+    cold first daemon did."""
+    monkeypatch.setenv("MYTHRIL_TPU_CACHE_DIR", str(tmp_path / "cache"))
+    global_args.solve_cache = "disk"
+    code = wrap_creation(KILLBILLY)
+    stats = SolverStatistics()
+
+    first = ServeDaemon(tx_count=1, deadline_s=120).start()
+    try:
+        cold = first.submit("alice", code).wait(240)
+        assert cold["status"] == "ok"
+        assert stats.persistent_stores > 0, \
+            "the cold daemon must populate the durable tier"
+    finally:
+        _drain(first)
+
+    # the crash: all in-memory state dies; the disk tier survives
+    model_mod.clear_caches()
+    stats.reset()
+    stats.enabled = True
+
+    second = ServeDaemon(tx_count=1, deadline_s=120).start()
+    try:
+        rewarmed = second.submit("alice", code).wait(240)
+        assert rewarmed["status"] == "ok"
+        assert rewarmed["issues"] == cold["issues"]
+        assert stats.persistent_hits > 0, \
+            "the restarted daemon must re-warm from the disk tier"
+        assert rewarmed["cdcl_settles"] < cold["cdcl_settles"]
+    finally:
+        _drain(second)
+
+
+# -- lifecycle: endpoints, drain, SIGTERM ------------------------------------
+
+
+def test_healthz_and_metrics_endpoints(tmp_path):
+    global_args.heartbeat = str(tmp_path / "beat.jsonl")
+    daemon = ServeDaemon(tx_count=1, deadline_s=120, http_port=0).start()
+    try:
+        assert daemon.port
+        out = daemon.submit("alice", wrap_creation(KILLBILLY)).wait(240)
+        assert out["status"] == "ok"
+        health = json.load(urllib.request.urlopen(
+            f"http://127.0.0.1:{daemon.port}/healthz", timeout=10))
+        assert health["status"] == "ok"
+        assert health["requests"]["admitted"] == 1
+        assert health["requests"]["completed"] == 1
+        metrics_text = urllib.request.urlopen(
+            f"http://127.0.0.1:{daemon.port}/metrics",
+            timeout=10).read().decode()
+        assert "mythril_tpu_serve_requests_admitted 1" in metrics_text
+        assert "mythril_tpu_serve_tenant_window_share" in metrics_text
+        assert "mythril_tpu_cdcl_settles" in metrics_text
+    finally:
+        _drain(daemon)
+    # graceful drain wrote the final reconciled heartbeat
+    lines = [json.loads(line) for line in
+             open(global_args.heartbeat, encoding="utf-8")]
+    assert lines and lines[-1]["final"] is True
+    assert lines[-1]["counters"]["serve_requests_completed"] == 1
+    assert SolverStatistics().serve_drain_wall > 0.0
+
+
+def test_http_analyze_endpoint_round_trip():
+    daemon = ServeDaemon(tx_count=1, deadline_s=120, http_port=0).start()
+    try:
+        body = json.dumps({"tenant": "http-client",
+                           "code": wrap_creation(KILLBILLY)}).encode()
+        request = urllib.request.Request(
+            f"http://127.0.0.1:{daemon.port}/analyze", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(request, timeout=240) as response:
+            assert response.status == 200
+            outcome = json.load(response)
+        assert outcome["status"] == "ok"
+        assert outcome["tenant"] == "http-client"
+        assert isinstance(outcome["issues"], list)
+    finally:
+        _drain(daemon)
+
+
+def test_legit_deadline_overrun_cancels_requeues_then_incomplete(
+        monkeypatch):
+    """A batch that GENUINELY overruns its deadline (no injection) is
+    deadline-killed, its abandoned slot threads cancelled (they may not
+    race the requeued batch over the engine globals), the request
+    requeued once and then answered `incomplete` — and the daemon stays
+    healthy for the next request. The overrun is forced with a
+    deterministic pre-analysis stall (a warm process can legitimately
+    finish small contracts inside any deadline tight enough to test)."""
+    import time as time_mod
+
+    import mythril_tpu.core as core_mod
+
+    real = core_mod.MythrilAnalyzer._analyze_one_contract
+
+    def stalled(self, contract, modules, tx_count, stats=None):
+        time_mod.sleep(1.5)  # well past the 0.2 s deadline, every time
+        return real(self, contract, modules, tx_count, stats=stats)
+
+    monkeypatch.setattr(core_mod.MythrilAnalyzer,
+                        "_analyze_one_contract", stalled)
+    daemon = ServeDaemon(tx_count=1, deadline_s=0.2).start()
+    try:
+        doomed = daemon.submit("slow", wrap_creation(KILLBILLY))
+        outcome = doomed.wait(120)
+        assert outcome["status"] == "incomplete"
+        stats = SolverStatistics()
+        assert stats.resilience_deadline_trips >= 2
+        assert stats.serve_requests_requeued == 1
+        assert stats.serve_requests_incomplete == 1
+        # the daemon survives the abandonment: a sane request completes
+        healthy = daemon.submit("ok", wrap_creation(OVERFLOW_ADD),
+                                deadline_s=120.0)
+        assert healthy.wait(240)["status"] == "ok"
+    finally:
+        _drain(daemon)
+
+
+def test_cancelled_coordinator_raises_at_yield_points():
+    """Coordinator.cancel() turns every yield point into
+    BatchCancelled, and a thread with no slot on the live coordinator
+    (an abandoned predecessor's engine thread) dies at its first
+    tick."""
+    from mythril_tpu.service import interleave
+
+    coordinator = interleave.Coordinator(
+        [(0, object())], origins=["t:x"], warm=False,
+        module_templates=[])
+    with pytest.raises(interleave.BatchCancelled):
+        coordinator.maybe_switch()  # this thread holds no slot
+    coordinator.cancel()
+    with pytest.raises(interleave.BatchCancelled):
+        coordinator._check_cancelled()
+
+
+def test_tenant_ids_with_colons_cannot_cross_evict():
+    """An adversarial tenant id containing ':' must not make one tenant
+    evictable by another (origin_in_session splits on the first colon;
+    minting colon-escapes the tenant)."""
+    code = wrap_creation(KILLBILLY)
+    plain = ServeRequest_origin("alice", code)
+    scoped = ServeRequest_origin("alice:prod", code)
+    assert plain != scoped
+    assert not tenancy.origin_in_session(scoped,
+                                         tenancy.encode_session("alice"))
+    assert tenancy.origin_in_session(
+        scoped, tenancy.encode_session("alice:prod"))
+    # distinct ids stay distinct under the escaping (injective)
+    assert tenancy.encode_session("a:b") != tenancy.encode_session("a%3Ab")
+
+
+def test_evict_refuses_while_tenant_in_flight():
+    daemon = ServeDaemon()  # worker not started: the request stays queued
+    daemon.submit("busy", wrap_creation(KILLBILLY))
+    assert daemon.evict_tenant("busy", wait_timeout=0.3) is False
+    assert daemon.evict_tenant("idle", wait_timeout=0.3) is True
+
+
+def test_http_malformed_code_answers_400():
+    daemon = ServeDaemon(tx_count=1, http_port=0).start()
+    try:
+        body = json.dumps({"tenant": "a", "code": "zz-not-hex"}).encode()
+        request = urllib.request.Request(
+            f"http://127.0.0.1:{daemon.port}/analyze", data=body,
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as excinfo:
+            urllib.request.urlopen(request, timeout=30)
+        assert excinfo.value.code == 400
+        assert json.load(excinfo.value)["status"] == "rejected"
+    finally:
+        _drain(daemon)
+
+
+@pytest.mark.slow
+def test_soak_concurrent_clients_with_fault_schedule():
+    """The soak invariants end to end (tools/soak_serve.py, small
+    scale): N concurrent HTTP clients over the committed corpus under a
+    seeded fault schedule — zero cross-request contamination, bounded
+    p99 admission latency, warm phase strictly cheaper than cold, and a
+    clean drain."""
+    import importlib.util
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "soak_serve", os.path.join(repo_root, "tools", "soak_serve.py"))
+    soak = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(soak)
+    result = soak.run_soak(
+        clients=3, requests_per_client=2,
+        faults_spec="serve.worker:raise:n2,device.dispatch:raise:n1,"
+                    "serve.request:raise:n3",
+        seed=7, deadline_s=60.0)
+    assert result["contamination"] == [], \
+        "a fault schedule must never leak one request's findings into "\
+        "another's"
+    assert result["clean_drain"]
+    assert result["tallies"]["ok"] >= 4, result["tallies"]
+    # the serve.request:raise:n3 poisons exactly one request, alone
+    assert result["tallies"].get("error", 0) <= 1
+    assert result["fewer_settles_warm"], \
+        "the warm phase must reuse the soak's memos"
+    assert result["p99_admission_s"] < 120.0, \
+        "admission latency must stay bounded under the storm"
+
+
+def test_sigterm_drains_cleanly():
+    from mythril_tpu.serve.daemon import install_signal_handlers
+
+    daemon = ServeDaemon(tx_count=1, deadline_s=120).start()
+    saved_term = signal.getsignal(signal.SIGTERM)
+    saved_int = signal.getsignal(signal.SIGINT)
+    try:
+        install_signal_handlers(daemon)
+        out = daemon.submit("alice", wrap_creation(KILLBILLY))
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert daemon.drained.wait(timeout=240), \
+            "SIGTERM must drain, not hang"
+        assert out.wait(1)["status"] == "ok", \
+            "in-flight work finishes before the daemon exits"
+        late = daemon.submit("bob", wrap_creation(KILLBILLY))
+        assert late.outcome["reason"] == "draining"
+    finally:
+        signal.signal(signal.SIGTERM, saved_term)
+        signal.signal(signal.SIGINT, saved_int)

@@ -66,6 +66,11 @@ TRAJECTORY_METRICS = (
     # stream evidence going dark would be a regression
     "xcontract.contracts_per_hour",
     "xcontract.windows",
+    # serve daemon: warm-vs-cold requests/hour is THE amortization
+    # number the long-lived loop exists for; containment going dark
+    # (contamination / dirty drain) would be a regression
+    "serve.warm_requests_per_hour",
+    "serve.zero_contamination",
 )
 
 _HIGHER_BETTER_RE = re.compile(
@@ -76,7 +81,9 @@ _HIGHER_BETTER_RE = re.compile(
     r"|forks|stream_dispatches"
     # cross-contract packing: corpus throughput (contracts/hour) and
     # mixed-origin windows both want to go UP
-    r"|per_hour|xcontract)")
+    r"|per_hour|xcontract"
+    # serve daemon: containment verdicts flipping false is a regression
+    r"|zero_contamination|clean_drain)")
 _LOWER_BETTER_RE = re.compile(
     r"(_s$|wall|cap_rejects|cdcl_settles|sol_gap|misses|fallbacks"
     r"|verify_rejects|degraded|deadline_trips|breaker_trips)")
@@ -183,6 +190,17 @@ def extract_metrics(payload: dict) -> Dict[str, object]:
         fusion.get("fork_stream_dispatches_total"))
     put("branch_fusion.findings_equal", fusion.get("findings_equal_all"))
     put("branch_fusion.fallbacks_on", fusion.get("fallback_exits_on"))
+    serve = extra.get("serve") or {}
+    put("serve.warm_requests_per_hour",
+        serve.get("warm_requests_per_hour"))
+    put("serve.cold_requests_per_hour",
+        serve.get("cold_requests_per_hour"))
+    put("serve.warm_speedup", serve.get("warm_speedup"))
+    put("serve.warm_memo_hits", serve.get("warm_memo_hits"))
+    put("serve.warm_cdcl_settles", serve.get("warm_cdcl_settles"))
+    put("serve.p99_admission_s", serve.get("p99_admission_s"))
+    put("serve.zero_contamination", serve.get("zero_contamination"))
+    put("serve.clean_drain", serve.get("clean_drain"))
     xcontract = extra.get("corpus_xcontract") or {}
     put("xcontract.contracts_per_hour",
         xcontract.get("contracts_per_hour"))

@@ -1,0 +1,247 @@
+#!/usr/bin/env python
+"""Serve-daemon soak harness: N concurrent clients over the committed
+corpus, optionally under a seeded fault schedule, asserting the daemon's
+three serving invariants end to end:
+
+  1. zero cross-request contamination — every `ok` response's findings
+     (witness-masked canonical form) match the no-fault per-contract
+     reference, no matter which tenants shared its batch or which
+     faults fired around it;
+  2. bounded admission latency — per-request queue wait is sampled from
+     the daemon's own admission clock (outcome `wait_s`); the p99 is
+     reported and, with --check, bounded;
+  3. a clean drain — after the storm, drain() finishes every admitted
+     request and returns True.
+
+Phases (one process, one daemon — the warm-tier contrast is the point):
+
+  cold   each corpus contract once, no faults: per-contract reference
+         findings + the cold requests/hour figure
+  soak   N clients x M requests each over HTTP (POST /analyze against
+         the real listener), fault schedule armed (seeded — the same
+         spec and seed reproduce the same storm)
+  warm   each contract once more, faults disarmed: warm requests/hour
+         and the memo-reuse evidence (memo hits, settle shrinkage)
+
+Usage:
+  python tools/soak_serve.py [--clients 4] [--requests-per-client 2]
+      [--faults SPEC] [--seed 0] [--corpus DIR] [--deadline 60]
+      [--check] [--p99-bound 30]
+
+Prints one JSON object; --check exits 1 on contamination / dirty drain /
+p99 past the bound. bench.py's serve leg runs this with small counts.
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+def _canonical(issues) -> str:
+    """Witness-masked canonical findings (the soak runs under fault
+    schedules, where a degraded solver configuration may legitimately
+    pick a different — equally valid — witness model)."""
+    issues = json.loads(json.dumps(issues))
+    for issue in issues:
+        for step in (issue.get("tx_sequence") or {}).get("steps", ()):
+            step["input"] = f"<{len(step.get('input', ''))//2}B>"
+            step["value"] = "<witness>"
+            # the tx SENDER is solver-chosen too: a warm quick-sat model
+            # may pick a different (equally valid) actor than the cold
+            # solve did
+            step["origin"] = "<witness>"
+    return json.dumps(
+        sorted(issues, key=lambda i: json.dumps(i, sort_keys=True)),
+        sort_keys=True)
+
+
+def _post_analyze(port: int, payload: dict, timeout: float) -> dict:
+    body = json.dumps(payload).encode()
+    request = urllib.request.Request(
+        f"http://127.0.0.1:{port}/analyze", data=body,
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(request, timeout=timeout) as response:
+            return json.load(response)
+    except urllib.error.HTTPError as error:  # 429/503/504 carry JSON too
+        try:
+            return json.load(error)
+        except Exception:
+            return {"status": "error", "reason": f"http {error.code}"}
+
+
+def run_soak(clients: int = 4, requests_per_client: int = 2,
+             faults_spec: str = "", seed: int = 0,
+             corpus_dir: str = None, deadline_s: float = 60.0,
+             tx_count: int = 1) -> dict:
+    from mythril_tpu.resilience import faults
+    from mythril_tpu.serve.daemon import ServeDaemon
+    from mythril_tpu.smt.solver.statistics import SolverStatistics
+
+    corpus_dir = corpus_dir or os.path.join(REPO_ROOT, "bench_inputs",
+                                            "corpus")
+    files = sorted(glob.glob(os.path.join(corpus_dir, "*.hex")))
+    if not files:
+        raise SystemExit(f"no corpus under {corpus_dir} "
+                         "(run tools/make_corpus.py --write)")
+    contracts = [(os.path.basename(path),
+                  open(path).read().strip()) for path in files]
+    os.environ.setdefault("MYTHRIL_TPU_FAULT_SEED", str(seed))
+
+    stats = SolverStatistics()
+    stats.reset()
+    stats.enabled = True
+    daemon = ServeDaemon(tx_count=tx_count, deadline_s=deadline_s,
+                         http_port=0).start()
+    result = {"contracts": len(contracts), "clients": clients,
+              "faults": faults_spec or None, "seed": seed}
+    try:
+        # -- cold phase: references + cold rate -------------------------------
+        reference = {}
+        cold_start = time.monotonic()
+        cold_settles_0 = stats.cdcl_settles
+        for name, code in contracts:
+            outcome = daemon.submit("reference", code, name=name).wait(
+                2 * deadline_s + 60)
+            if outcome is None or outcome["status"] != "ok":
+                raise SystemExit(
+                    f"cold reference request for {name} failed: {outcome}")
+            reference[name] = _canonical(outcome["issues"])
+        cold_wall = time.monotonic() - cold_start
+        cold_settles = stats.cdcl_settles - cold_settles_0
+
+        # -- soak phase: concurrent clients under the fault schedule ----------
+        faults.configure(faults_spec or None)
+        tallies = {"ok": 0, "error": 0, "incomplete": 0, "rejected": 0}
+        contamination = []
+        waits = []
+        lock = threading.Lock()
+
+        def client(ci: int) -> None:
+            for ri in range(requests_per_client):
+                name, code = contracts[(ci + ri) % len(contracts)]
+                outcome = _post_analyze(
+                    daemon.port,
+                    {"tenant": f"client{ci}", "code": code, "name": name,
+                     "tx_count": tx_count},
+                    timeout=2 * deadline_s + 90)
+                with lock:
+                    tallies[outcome.get("status", "error")] = \
+                        tallies.get(outcome.get("status", "error"), 0) + 1
+                    if "wait_s" in outcome:
+                        waits.append(outcome["wait_s"])
+                    if outcome.get("status") == "ok" \
+                            and _canonical(outcome["issues"]) \
+                            != reference[name]:
+                        contamination.append(
+                            {"client": ci, "contract": name})
+
+        soak_start = time.monotonic()
+        threads = [threading.Thread(target=client, args=(ci,), daemon=True)
+                   for ci in range(clients)]
+        for thread in threads:
+            thread.start()
+        for thread in threads:
+            thread.join()
+        soak_wall = time.monotonic() - soak_start
+        faults.configure(None)
+
+        # -- warm phase: same contracts again, faults off ---------------------
+        warm_start = time.monotonic()
+        warm_settles_0 = stats.cdcl_settles
+        warm_memo_hits = 0
+        for name, code in contracts:
+            outcome = daemon.submit("reference", code, name=name).wait(
+                2 * deadline_s + 60)
+            if outcome is None or outcome["status"] != "ok":
+                raise SystemExit(
+                    f"warm request for {name} failed: {outcome}")
+            if _canonical(outcome["issues"]) != reference[name]:
+                contamination.append({"client": "warm", "contract": name})
+            warm_memo_hits += outcome.get("memo_hits", 0)
+        warm_wall = time.monotonic() - warm_start
+        warm_settles = stats.cdcl_settles - warm_settles_0
+
+        waits.sort()
+        p99 = waits[max(0, int(len(waits) * 0.99) - 1)] if waits else 0.0
+        result.update({
+            "soak_requests": clients * requests_per_client,
+            "tallies": tallies,
+            "contamination": contamination,
+            "soak_wall_s": round(soak_wall, 2),
+            "p99_admission_s": round(p99, 4),
+            "mean_admission_s": (round(sum(waits) / len(waits), 4)
+                                 if waits else 0.0),
+            "cold_wall_s": round(cold_wall, 2),
+            "warm_wall_s": round(warm_wall, 2),
+            "cold_requests_per_hour": (
+                round(3600.0 * len(contracts) / cold_wall, 1)
+                if cold_wall else None),
+            "warm_requests_per_hour": (
+                round(3600.0 * len(contracts) / warm_wall, 1)
+                if warm_wall else None),
+            "warm_speedup": (round(cold_wall / warm_wall, 3)
+                             if warm_wall else None),
+            "cold_cdcl_settles": cold_settles,
+            "warm_cdcl_settles": warm_settles,
+            "fewer_settles_warm": warm_settles < cold_settles,
+            "warm_memo_hits": warm_memo_hits,
+            "requests_requeued": stats.serve_requests_requeued,
+            "requests_incomplete": stats.serve_requests_incomplete,
+            "requests_rejected": stats.serve_requests_rejected,
+        })
+    finally:
+        faults.configure(None)
+        clean = daemon.drain(timeout=max(120.0, 2 * deadline_s))
+        result["clean_drain"] = clean
+        result["drain_wall_s"] = round(stats.serve_drain_wall, 3)
+    return result
+
+
+def main(argv) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--clients", type=int, default=4)
+    parser.add_argument("--requests-per-client", type=int, default=2)
+    parser.add_argument("--faults", default="",
+                        help="fault spec armed during the soak phase "
+                             "(resilience/faults.py grammar)")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--corpus", default=None)
+    parser.add_argument("--deadline", type=float, default=60.0)
+    parser.add_argument("--tx", type=int, default=1)
+    parser.add_argument("--check", action="store_true",
+                        help="exit 1 on contamination, dirty drain, or "
+                             "p99 admission latency past --p99-bound")
+    parser.add_argument("--p99-bound", type=float, default=30.0,
+                        help="seconds (with --check)")
+    parsed = parser.parse_args(argv[1:])
+    result = run_soak(clients=parsed.clients,
+                      requests_per_client=parsed.requests_per_client,
+                      faults_spec=parsed.faults, seed=parsed.seed,
+                      corpus_dir=parsed.corpus,
+                      deadline_s=parsed.deadline, tx_count=parsed.tx)
+    print(json.dumps(result))
+    if parsed.check:
+        if result["contamination"]:
+            print("FAIL: cross-request contamination", file=sys.stderr)
+            return 1
+        if not result["clean_drain"]:
+            print("FAIL: dirty drain", file=sys.stderr)
+            return 1
+        if result["p99_admission_s"] > parsed.p99_bound:
+            print(f"FAIL: p99 admission {result['p99_admission_s']}s "
+                  f"> {parsed.p99_bound}s", file=sys.stderr)
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
