@@ -238,6 +238,14 @@ class MythrilAnalyzer:
             module.reset_cache()
         stats = SolverStatistics()
         stats.enabled = True
+        # tuned schedule profile (mythril_tpu/tune/): install the
+        # persisted per-platform winner as the knob tuned tier BEFORE
+        # any consumer (router, scheduler, backend, frontier) reads its
+        # knobs — one-shot per process, explicit env always wins,
+        # MYTHRIL_TPU_AUTOTUNE=0 disables
+        from mythril_tpu.tune import apply_tuned_profile
+
+        apply_tuned_profile()
         # fault-injection harness (resilience/faults.py): armed from
         # MYTHRIL_TPU_FAULTS or --inject-fault, disarmed when neither is
         # set — one configure per run so crossing counters start fresh
@@ -752,6 +760,12 @@ def _corpus_worker(payload):
         module.reset_cache()
     stats = SolverStatistics()
     stats.enabled = True
+    # workers resolve knobs through the same tuned tier as the parent
+    # (spawn starts a fresh interpreter — the parent's applied profile
+    # does not cross the process boundary by itself)
+    from mythril_tpu.tune import apply_tuned_profile
+
+    apply_tuned_profile()
     # always-on ring in the worker too: a worker that trips a breaker or
     # a deadline dumps its own flight-recorder artifact (per-pid files)
     from mythril_tpu.observe import flightrec

@@ -107,6 +107,37 @@ def build_parser() -> argparse.ArgumentParser:
                        help="log level 0-5")
     add_analysis_args(serve)
 
+    autotune = subparsers.add_parser(
+        "autotune",
+        help="measured schedule search over the knob space: benchmark "
+             "candidate configs on a bounded probe workload (committed "
+             "bench corpus by default) under a hard findings-parity "
+             "guard, persist the per-platform winner as a tuned profile "
+             "beside the calibration cache")
+    autotune.add_argument("-f", "--codefile", action="append",
+                          help="probe input file(s) containing hex "
+                               "bytecode (default: bench_inputs/corpus)")
+    autotune.add_argument("--bin-runtime", action="store_true",
+                          help="treat probe inputs as runtime code")
+    autotune.add_argument("-t", "--transaction-count", type=int, default=1)
+    autotune.add_argument("--candidates", type=int, default=None,
+                          help="candidate configurations to measure "
+                               "(MYTHRIL_TPU_AUTOTUNE_CANDIDATES or 8)")
+    autotune.add_argument("--budget", type=float, default=None,
+                          help="per-candidate wall budget in seconds "
+                               "(MYTHRIL_TPU_AUTOTUNE_BUDGET or 180)")
+    autotune.add_argument("--rounds", type=int, default=None,
+                          help="successive-halving measurement rounds (2)")
+    autotune.add_argument("--min-delta", type=float, default=None,
+                          dest="min_delta",
+                          help="minimum relative improvement before a "
+                               "winner persists "
+                               "(MYTHRIL_TPU_AUTOTUNE_MIN_DELTA or 0.02)")
+    autotune.add_argument("--force", action="store_true",
+                          help="re-search even when a tuned profile for "
+                               "this platform + probe already exists")
+    autotune.add_argument("-v", "--verbose", type=int, default=2)
+
     concolic = subparsers.add_parser("concolic", help="concolic branch flipping")
     concolic.add_argument("input", help="concrete input json")
     concolic.add_argument("--branches", required=True,
@@ -410,7 +441,18 @@ def execute_command(parsed) -> int:
             print(contract.get_creation_easm())
         return 0
 
+    if command == "autotune":
+        from mythril_tpu.tune.search import run_autotune
+
+        return run_autotune(parsed)
+
     if command == "serve":
+        # the daemon reads its batch width (and every solver knob) at
+        # construction: install the tuned profile first so a tuned
+        # MYTHRIL_TPU_SERVE_BATCH reaches it (env still absolute)
+        from mythril_tpu.tune import apply_tuned_profile
+
+        apply_tuned_profile()
         from mythril_tpu.core import MythrilAnalyzer, MythrilDisassembler
         from mythril_tpu.serve.daemon import (
             DEFAULT_PORT,

@@ -246,6 +246,11 @@ def snapshot(seq: int = 0, final: bool = False) -> dict:
              for name in fault_registry.FAULT_SITES}
     for site, events in stats.resilience_events.items():
         sites.setdefault(site, dict(events))
+    # the resolved knob configuration (value + source per knob): a
+    # heartbeat stream is attributable to its schedule the same way the
+    # exit stats JSON is (mythril_tpu/tune/space.py)
+    from mythril_tpu.tune import space as tune_space
+
     snap = stamp()
     snap.update({
         "seq": seq,
@@ -257,6 +262,7 @@ def snapshot(seq: int = 0, final: bool = False) -> dict:
         "histograms": histograms,
         "roofline": roofline_view,
         "resilience": sites,
+        "knobs": tune_space.resolved_config(),
     })
     return snap
 

@@ -24,6 +24,11 @@ from mythril_tpu.support.lock import LockFile
 log = logging.getLogger(__name__)
 
 CALIBRATION_SCHEMA_VERSION = 1
+# schema of the per-platform `tuned` section the autotune search persists
+# beside the measurement entries (mythril_tpu/tune/search.py) — bumped
+# independently of the calibration schema: a stale tuned layout must be
+# ignored (with a counted event) without invalidating the measurements
+TUNED_SCHEMA_VERSION = 1
 _FILENAME = "calibration.json"
 
 # stage speed-of-light rates persisted beside per_cell_s (additive keys —
@@ -81,6 +86,13 @@ def load_profile(platform: Optional[str], restarts: int,
         rate = entry.get(key)
         if isinstance(rate, (int, float)) and rate >= 0:
             out[key] = float(rate)
+    # measured first-call XLA compile cost of the calibration round
+    # (seconds, not a rate): feeds the evidence-mode ragged-chunk auto
+    # default (router._auto_chunk_cones). Entries that predate it simply
+    # lack the key — consumers fall back to the measured-in-PR-12 floor.
+    compile_s = entry.get("compile_s")
+    if isinstance(compile_s, (int, float)) and compile_s >= 0:
+        out["compile_s"] = float(compile_s)
     return out
 
 
@@ -127,3 +139,119 @@ def save_profile(platform: Optional[str], restarts: int, steps: int,
 def save_per_cell_latency(platform: Optional[str], restarts: int,
                           steps: int, per_cell_s: float) -> None:
     save_profile(platform, restarts, steps, {"per_cell_s": per_cell_s})
+
+
+# -- tuned profiles (mythril_tpu/tune/) ---------------------------------------
+#
+# The autotune search persists its measured winner as a per-platform
+# `tuned` section in the same file, beside the calibration entries it was
+# searched against. Unlike the measurement entries, the tuned section is
+# an explicit operator artifact, not a cache tier: load/save are NOT
+# gated on disk_tier_enabled(), so a profile tuned once applies to every
+# later run regardless of --solve-cache mode, and clear_caches() (which
+# only drops in-process state) can never lose it.
+
+
+def _read_payload() -> dict:
+    try:
+        with open(_path()) as fd:
+            payload = json.load(fd)
+    except (OSError, ValueError):
+        return {}
+    return payload if isinstance(payload, dict) else {}
+
+
+def tuned_platforms() -> list:
+    """Platform keys with a present (not necessarily valid) tuned entry
+    — the platform-guess fallback for unpinned processes that have not
+    initialized jax yet (tune.default_platform)."""
+    section = _read_payload().get("tuned")
+    if not isinstance(section, dict):
+        return []
+    return sorted(name for name in section if isinstance(name, str))
+
+
+def measured_platforms() -> list:
+    """Platforms this machine's calibration MEASUREMENTS were taken on
+    (entry keys are "platform|rN|sM", written only by processes whose
+    jax actually initialized here) — the ground truth a platform guess
+    can be checked against."""
+    entries = _read_payload().get("entries")
+    if not isinstance(entries, dict):
+        return []
+    return sorted({key.split("|", 1)[0] for key in entries
+                   if isinstance(key, str) and "|" in key})
+
+
+def load_tuned(platform: Optional[str]):
+    """(tuned profile dict, None) for this platform, (None, reject
+    reason) for a present-but-unusable section (corrupt file, stale
+    schema, malformed knobs — the caller counts the event), or
+    (None, None) when nothing was ever tuned."""
+    if not platform:
+        return None, None
+    path = _path()
+    if not os.path.isfile(path):
+        return None, None
+    try:
+        with open(path) as fd:
+            payload = json.load(fd)
+    except (OSError, ValueError):
+        return None, "unreadable"
+    section = payload.get("tuned") if isinstance(payload, dict) else None
+    if not isinstance(section, dict):
+        return None, None if section is None else "malformed"
+    entry = section.get(platform)
+    if entry is None:
+        return None, None
+    if not isinstance(entry, dict):
+        return None, "malformed"
+    if entry.get("schema") != TUNED_SCHEMA_VERSION:
+        return None, "stale-schema"
+    knobs = entry.get("knobs")
+    if not isinstance(knobs, dict) or not knobs or not all(
+            isinstance(name, str) and isinstance(value, (int, float))
+            and not isinstance(value, bool)
+            for name, value in knobs.items()):
+        return None, "malformed"
+    return entry, None
+
+
+def save_tuned(platform: Optional[str], entry: dict) -> bool:
+    """Persist one platform's tuned profile (schema stamp added here).
+    Read-modify-write under the same lock as the measurement entries so
+    a concurrent calibration save cannot tear the section."""
+    if not platform or not isinstance(entry.get("knobs"), dict):
+        return False
+    path = _path()
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with LockFile(path + ".lock"):
+            payload = {"schema": CALIBRATION_SCHEMA_VERSION, "entries": {}}
+            try:
+                with open(path) as fd:
+                    existing = json.load(fd)
+                if existing.get("schema") == CALIBRATION_SCHEMA_VERSION:
+                    payload = existing
+                    payload.setdefault("entries", {})
+                elif isinstance(existing.get("tuned"), dict):
+                    # the tuned section is versioned INDEPENDENTLY
+                    # (TUNED_SCHEMA_VERSION): a calibration-schema bump
+                    # drops the measurement entries, never the other
+                    # platforms' still-valid tuned profiles
+                    payload["tuned"] = existing["tuned"]
+            except (OSError, ValueError):
+                pass
+            tuned = payload.get("tuned")
+            if not isinstance(tuned, dict):
+                tuned = {}
+            tuned[platform] = {**entry, "schema": TUNED_SCHEMA_VERSION,
+                               "tuned_at": int(time.time())}
+            payload["tuned"] = tuned
+            from mythril_tpu.service.store import atomic_write_json
+
+            atomic_write_json(path, payload)
+        return True
+    except OSError as error:
+        log.info("could not persist tuned profile (%s)", error)
+        return False

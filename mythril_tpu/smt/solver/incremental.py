@@ -59,6 +59,23 @@ PREFIX_MEMO_MAX = 32
 # snapshots past this many lowering-cache entries are not recorded: the
 # clone cost and retained memory would outweigh the resume win
 SNAPSHOT_NODE_CAP = 200_000
+
+
+def _prefix_memo_max() -> int:
+    """Live prefix-memo cap: MYTHRIL_TPU_PREFIX_MEMO_MAX (env or tuned
+    profile — support/env resolution) over the module default. Read at
+    use, not import, so a tuned profile applied at startup reaches it."""
+    from mythril_tpu.support.env import env_int
+
+    return env_int("MYTHRIL_TPU_PREFIX_MEMO_MAX", PREFIX_MEMO_MAX)
+
+
+def _snapshot_node_cap() -> int:
+    """Live snapshot-size cap: MYTHRIL_TPU_SNAPSHOT_NODE_CAP (env or
+    tuned profile) over the module default."""
+    from mythril_tpu.support.env import env_int
+
+    return env_int("MYTHRIL_TPU_SNAPSHOT_NODE_CAP", SNAPSHOT_NODE_CAP)
 # mirrors propagate_equalities' max_rounds for the suffix fixpoint
 SUFFIX_ROUNDS = 8
 
@@ -289,7 +306,7 @@ def record(asserted, residual, substitutions, taken_equal, taken_narrow,
     live object)."""
     if not asserted:
         return
-    if len(lowering.cache) > SNAPSHOT_NODE_CAP:
+    if len(lowering.cache) > _snapshot_node_cap():
         return
     state = _state()
     key = tuple(id(t) for t in asserted)
@@ -312,7 +329,7 @@ def record(asserted, residual, substitutions, taken_equal, taken_narrow,
         lowered=tuple(lowered),
     )
     state.lengths[len(key)] = state.lengths.get(len(key), 0) + 1
-    while len(state.prefix_memo) > PREFIX_MEMO_MAX:
+    while len(state.prefix_memo) > _prefix_memo_max():
         old_key, _old = state.prefix_memo.popitem(last=False)
         state.origins.pop(old_key, None)
         live = state.lengths.get(len(old_key), 0) - 1

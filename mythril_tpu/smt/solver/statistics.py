@@ -145,6 +145,16 @@ class SolverStatistics:
         "serve_batches",
         "serve_batch_requests",
         "serve_batch_tenants",
+        # autotune loop (mythril_tpu/tune/): search candidates measured,
+        # candidates rejected by the findings-parity guard / by measuring
+        # no better than the default config, tuned knobs actually live
+        # this process (profile applied, not shadowed by explicit env),
+        # and corrupt/stale tuned profiles ignored at apply time
+        "autotune_candidates_tried",
+        "autotune_rejected_parity",
+        "autotune_rejected_regression",
+        "tuned_knobs_applied",
+        "tuned_profile_rejects",
         "resilience_retries",
         "resilience_breaker_trips",
         "resilience_breaker_probes",
@@ -648,6 +658,38 @@ class SolverStatistics:
         if self.enabled:
             self.serve_drain_wall += seconds
 
+    def add_autotune_candidate(self) -> None:
+        """One candidate configuration measured by the autotune search."""
+        if self.enabled:
+            self.autotune_candidates_tried += 1
+
+    def add_autotune_rejected(self, parity: bool) -> None:
+        """A tried candidate rejected: `parity` = its probe findings
+        were not byte-identical to the default config's (the hard guard
+        — its wall never ranked); otherwise it was not persisted — no
+        better than the default config within the margin, eliminated by
+        a successive-halving round, or failed/timed out under the
+        candidate budget. candidates_tried always reconciles as
+        parity + regression + (1 if a winner persisted)."""
+        if self.enabled:
+            if parity:
+                self.autotune_rejected_parity += 1
+            else:
+                self.autotune_rejected_regression += 1
+
+    def add_tuned_knobs_applied(self, count: int) -> None:
+        """Tuned-profile knobs live this process (installed at startup
+        and not shadowed by an explicit env var)."""
+        if self.enabled:
+            self.tuned_knobs_applied += count
+
+    def add_tuned_profile_reject(self) -> None:
+        """A persisted tuned profile ignored at apply time (corrupt
+        file, stale schema, unregistered/malformed knobs) — counted so a
+        silently-defaulting run says why."""
+        if self.enabled:
+            self.tuned_profile_rejects += 1
+
     @property
     def serve_tenant_window_share(self) -> float:
         """Mean requests each tenant contributed per serve batch — the
@@ -744,6 +786,12 @@ class SolverStatistics:
             "sites": sites,
             "faults_active": faults.active_spec(),
         }
+        # the fully-resolved knob configuration (value + source tier:
+        # env/cli/tuned/default per knob) — every stats artifact says
+        # exactly which schedule produced it (mythril_tpu/tune/space.py)
+        from mythril_tpu.tune import space as tune_space
+
+        out["knobs"] = tune_space.resolved_config()
         # span-summary of the run's trace ({stage: [count, seconds]};
         # empty unless MYTHRIL_TPU_TRACE / --trace enabled the tracer)
         from mythril_tpu.observe.tracer import Tracer

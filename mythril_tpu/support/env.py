@@ -1,13 +1,96 @@
-"""Shared environment-variable parsing (one implementation instead of a
-try/except copy per module — the copies were already drifting)."""
+"""Shared environment-variable parsing and knob resolution.
+
+One implementation instead of a try/except copy per module (the copies
+were already drifting) — and, since the autotune loop closed, the single
+seam every tunable knob resolves through. Resolution precedence for a
+knob read:
+
+    explicit env var  >  CLI-provided value  >  tuned profile  >  default
+
+The tuned tier is populated once per process by
+mythril_tpu.tune.apply_tuned_profile() from the persisted per-platform
+profile (service/calibration.py `tuned` section); because every consumer
+already reads its knobs through env_float/env_int here, applying a
+profile needs no per-site changes. An explicit env var is ALWAYS
+absolute — a tuned profile can never override an operator's hand-set
+value. resolve_source() exposes which tier actually supplied each knob,
+so the stats JSON / heartbeat can stamp the fully-resolved configuration
+(value + source) onto every run.
+"""
 
 import os
+from typing import Dict, Optional, Tuple
+
+# tuned-profile tier (mythril_tpu/tune/): knob env name -> value, set by
+# apply_tuned_profile(); empty until a profile is applied
+_TUNED: Dict[str, object] = {}
+# CLI tier: a flag that maps 1:1 onto a knob records its value here (no
+# current knob has a dedicated flag, but the tier keeps the documented
+# precedence honest when one grows)
+_CLI: Dict[str, object] = {}
+
+
+def set_tuned(mapping: Dict[str, object]) -> None:
+    """Install the tuned-profile tier (replaces any previous mapping)."""
+    _TUNED.clear()
+    _TUNED.update(mapping)
+
+
+def tuned_values() -> Dict[str, object]:
+    return dict(_TUNED)
+
+
+def set_cli(name: str, value) -> None:
+    """Record a CLI-flag-provided knob value (beats tuned, loses to env)."""
+    _CLI[name] = value
+
+
+def clear_overrides() -> None:
+    """Drop the tuned and CLI tiers (tests / args.reset)."""
+    _TUNED.clear()
+    _CLI.clear()
+
+
+def _resolve(name: str, default, cast) -> Tuple[object, str]:
+    """(value, source) through the full precedence chain. A mistyped
+    knob must never crash a run at import time: a PRESENT-but-malformed
+    env var pins the built-in default (the pre-tuned-tier behavior —
+    an explicit env var, even a broken one, is absolute and must never
+    be silently replaced by a tuned value), while a malformed cli/tuned
+    entry falls through to the next tier."""
+    raw = os.environ.get(name)
+    if raw is not None:
+        try:
+            return cast(raw), "env"
+        except (TypeError, ValueError):
+            return default, "default"
+    for tier, source in ((_CLI, "cli"), (_TUNED, "tuned")):
+        if name in tier:
+            try:
+                return cast(tier[name]), source
+            except (TypeError, ValueError):
+                pass
+    return default, "default"
+
+
+def resolve_source(name: str, default=None, kind: str = "float"
+                   ) -> Tuple[object, str]:
+    """(resolved value, source tier) for stamping — same chain the
+    readers below use, without caching anything."""
+    cast = _cast_int if kind == "int" else float
+    return _resolve(name, default, cast)
+
+
+def _cast_int(value) -> int:
+    return int(float(value))
 
 
 def env_float(name: str, default: float) -> float:
-    """`float(os.environ[name])`, or `default` when unset/malformed — a
-    mistyped knob must never crash a run at import time."""
-    try:
-        return float(os.environ[name])
-    except (KeyError, ValueError):
-        return default
+    """Resolved float knob: env > cli > tuned > `default`."""
+    return _resolve(name, default, float)[0]
+
+
+def env_int(name: str, default: int) -> int:
+    """Resolved int knob: env > cli > tuned > `default` (lenient cast:
+    a tuned profile may round-trip ints through JSON floats)."""
+    return _resolve(name, default, _cast_int)[0]

@@ -144,13 +144,22 @@ def get_device_backend() -> "DeviceSolverBackend":
 class DeviceSolverBackend:
     def __init__(self, num_restarts: Optional[int] = None,
                  steps_per_round: int = 64, noise: float = 0.35):
-        # explicit constructor arg wins; the env var only sets the default
+        from mythril_tpu.support.env import env_int
+
+        # explicit constructor arg wins; the env var (or a tuned-profile
+        # knob — support/env resolution) only sets the default
         if num_restarts is None:
-            num_restarts = int(os.environ.get("MYTHRIL_TPU_RESTARTS", 64))
+            num_restarts = env_int("MYTHRIL_TPU_RESTARTS", 64)
         self.num_restarts = num_restarts
         # kept for constructor compatibility; only the circuit kernel's
         # CIRCUIT_STEPS drives the device loop now
         self.steps_per_round = steps_per_round
+        # MYTHRIL_TPU_CIRCUIT_STEPS (env or tuned profile) shadows the
+        # class default per instance, so tests monkeypatching the class
+        # attribute keep working when the knob is unset
+        circuit_steps = env_int("MYTHRIL_TPU_CIRCUIT_STEPS", 0)
+        if circuit_steps > 0:
+            self.CIRCUIT_STEPS = circuit_steps
         self.noise = noise
         self.queries = 0
         self.sat_found = 0

@@ -116,10 +116,13 @@ Env summary (all optional):
                                 stream; default 4; 0 disables ragged
                                 dispatch there)
   MYTHRIL_TPU_RAGGED_CHUNK_CONES  cones per assembled ragged stream
-                                (0 = auto: 2 in evidence mode where every
-                                fresh combined shape pays its compile
-                                inside the dispatch deadline, unbounded
-                                on a real device)
+                                (0 = auto: derived in evidence mode from
+                                the measured XLA-compile / dispatch-
+                                deadline ratio in the calibration
+                                profile — clamp(deadline / 2*compile_s,
+                                2, 8), floor 2 when unmeasured —
+                                unbounded on a real device; the env
+                                override stays absolute)
   MYTHRIL_TPU_CUBE_VARS         cube-and-conquer split width k (2^k
                                 cubes per hard cone; default 3 on the
                                 CPU platform, 7 on a real device; 0
@@ -249,6 +252,10 @@ class QueryRouter:
         # round (pack_bytes_s / ship_bytes_s / settle_clauses_s) — the
         # roofline ceilings (observe/roofline.py)
         self._stage_rates = {}
+        # measured first-call XLA compile cost of the calibration round
+        # (seconds) — drives the evidence-mode ragged-chunk auto default
+        # (_auto_chunk_cones); None until measured or cache-loaded
+        self._compile_s = None
         self._calibrated = False
         from mythril_tpu.resilience import StageBreaker
 
@@ -396,6 +403,8 @@ class QueryRouter:
         cached = load_profile(platform, restarts, steps)
         if cached is not None:
             self._per_cell_s = cached["per_cell_s"]
+            if cached.get("compile_s"):
+                self._compile_s = cached["compile_s"]
             self._stage_rates = {
                 key: float(cached[key]) for key in STAGE_RATE_KEYS
                 if isinstance(cached.get(key), (int, float))
@@ -446,6 +455,8 @@ class QueryRouter:
                      time.monotonic() - start)
             save_profile(platform, restarts, steps,
                          {"per_cell_s": self._per_cell_s,
+                          **({"compile_s": self._compile_s}
+                             if self._compile_s else {}),
                           **({key: 0.0 for key in STAGE_RATE_KEYS}
                              if self._stage_rates else {}),
                           **self._stage_rates})
@@ -533,13 +544,20 @@ class QueryRouter:
         ).astype(jax.numpy.int32)
         keys = jax.random.split(jax.random.PRNGKey(1), 1)
         walk = pc.num_levels + 4
-        # first call pays compile; the second measures the steady state
+        # first call pays compile; the second measures the steady state.
+        # Their difference is the (approximate) XLA compile cost of one
+        # fresh calibration-sized shape — persisted so the evidence-mode
+        # ragged chunk cap can be derived from measurement instead of
+        # the hardcoded 2 (ROADMAP PR-12 caveat)
+        t0 = time.monotonic()
         jax.block_until_ready(circuit.run_round_circuit_batch(
             tensors, x, keys, steps=CAL_STEPS, walk_depth=walk))
+        first_elapsed = time.monotonic() - t0
         t0 = time.monotonic()
         jax.block_until_ready(circuit.run_round_circuit_batch(
             tensors, x, keys, steps=CAL_STEPS, walk_depth=walk))
         elapsed = time.monotonic() - t0
+        self._compile_s = max(first_elapsed - elapsed, 0.0)
         # sim (levels x width cells) + walk (~levels) per step -> the
         # 2x folds the walk into the cell constant
         cells = pc.num_levels * max(pc.max_width, 1)
@@ -809,6 +827,24 @@ class QueryRouter:
         (the CPU platform: fake devices time-slicing the host core) — the
         device still fires, but under the per-process dispatch cap."""
         return self._platform() == "cpu"
+
+    def _auto_chunk_cones(self) -> int:
+        """Evidence-mode cones-per-chunk auto default for MIXED-origin
+        ragged windows, derived from the measured compile/deadline ratio
+        instead of PR-12's hardcoded 2 (the env/tuned override in
+        ragged_chunk_cones stays absolute — this only runs when it is 0).
+        A fresh k-cone combined rectangle pays roughly k x the
+        calibration cone's XLA compile inside the dispatch deadline
+        (measured: 8-cone mixed shapes blew the hard deadline, 4-cone
+        tripped it intermittently), so the cap keeps the projected
+        compile within half the deadline: k = deadline / (2 * compile_s),
+        clamped to [2, 8]. No measured compile cost (pre-compile_s cache
+        entry, failed calibration) keeps the measured-in-PR-12 floor."""
+        compile_s = self._compile_s or 0.0
+        if compile_s <= 0:
+            return 2
+        return max(2, min(8, int(self.dispatch_deadline()
+                                 / (2.0 * compile_s))))
 
     def _dispatches_remaining(self) -> int:
         if not self._evidence_mode():
@@ -1178,7 +1214,8 @@ class QueryRouter:
         if len({unit.origin for unit in window
                 if unit.origin is not None}) >= 2:
             cone_cap = self.ragged_chunk_cones \
-                or (2 if self._evidence_mode() else 0)
+                or (self._auto_chunk_cones() if self._evidence_mode()
+                    else 0)
         # the same amortized assembly+upload wall admission charges: a
         # chunk packed to the raw round estimate alone would leave no
         # headroom for stream prep inside the dispatch deadline

@@ -1,0 +1,155 @@
+"""Closed autotune loop: measured schedule search + tuned-profile apply.
+
+Subsystem layout (the TVM measured-schedule-search pattern over the
+telemetry PR 7/10 built):
+
+  space.py   declarative knob space — every tunable registered with
+             type/default/stage-affinity/candidates
+  search.py  the `mythril_tpu autotune` driver: gap-directed candidate
+             proposal, successive-halving measurement on a bounded probe
+             workload, hard findings-parity guard, per-platform
+             persistence (service/calibration.py `tuned` section)
+  (here)     apply_tuned_profile(): load the persisted winner at process
+             startup and install it as support/env's tuned tier, so
+             every knob consumer resolves it without per-site changes —
+             strict precedence explicit env > CLI flag > tuned > default
+
+MYTHRIL_TPU_AUTOTUNE=0 disables profile application entirely (the bench
+`tuned_vs_default` leg's default side, and the hard off-switch when a
+stale profile must be ruled out live).
+"""
+
+import logging
+import os
+
+log = logging.getLogger(__name__)
+
+AUTOTUNE_ENV = "MYTHRIL_TPU_AUTOTUNE"
+BUDGET_ENV = "MYTHRIL_TPU_AUTOTUNE_BUDGET"
+CANDIDATES_ENV = "MYTHRIL_TPU_AUTOTUNE_CANDIDATES"
+MIN_DELTA_ENV = "MYTHRIL_TPU_AUTOTUNE_MIN_DELTA"
+
+# the autotune counters every consumer must carry (SolverStatistics
+# fields; tools/check_stats_keys.py pins them to the stats JSON and the
+# bench ROUTING_KEYS roll-up explicitly)
+TUNE_COUNTERS = (
+    "autotune_candidates_tried",
+    "autotune_rejected_parity",
+    "autotune_rejected_regression",
+    "tuned_knobs_applied",
+    "tuned_profile_rejects",
+)
+
+_applied = False
+_applied_count = 0   # knobs live from the applied profile (for late count)
+_counted = False     # tuned_knobs_applied reached an ENABLED stats singleton
+
+
+def autotune_enabled() -> bool:
+    return os.environ.get(AUTOTUNE_ENV, "") not in ("0", "off", "false")
+
+
+def reset_applied() -> None:
+    """Forget that a profile was applied this process (tests)."""
+    global _applied, _applied_count, _counted
+    _applied = False
+    _applied_count = 0
+    _counted = False
+
+
+def default_platform():
+    """Best available platform WITHOUT initializing jax (profile
+    application runs at startup, before any backend materializes): an
+    initialized jax backend wins, then the JAX_PLATFORMS pin. Failing
+    both, a guess must be GROUNDED before a profile may apply under it:
+    exactly one platform ever tuned AND this machine's own calibration
+    measurements (written only by initialized-jax processes here) name
+    no other platform — that covers both the unpinned TPU box whose
+    probes persisted "tpu" (a cold "cpu" guess would never load it) and
+    the cpu stand-in. Anything else — ambiguous section, or a cpu-only
+    profile on a box whose measurements say "tpu" — returns None and NO
+    profile applies: a schedule measured on one platform must never
+    silently govern another. Returns str or None (unknown)."""
+    from mythril_tpu.observe.metrics import jax_platform
+
+    platform = jax_platform()
+    if platform and platform != "uninitialized":
+        return platform
+    pinned = os.environ.get("JAX_PLATFORMS", "")
+    if pinned:
+        return pinned.split(",")[0].strip() or "cpu"
+    from mythril_tpu.service.calibration import (
+        measured_platforms,
+        tuned_platforms,
+    )
+
+    tuned = tuned_platforms()
+    if len(tuned) == 1:
+        measured = measured_platforms()
+        if not measured or measured == tuned:
+            return tuned[0]
+    return None
+
+
+def apply_tuned_profile(platform=None, force: bool = False) -> int:
+    """Install the persisted tuned profile for `platform` (resolved via
+    default_platform() when None) as support/env's tuned tier. One-shot
+    per process (idempotent across repeated fire_lasers calls); `force`
+    re-applies. Returns the number of knobs installed (0 when disabled,
+    absent, or rejected). Corrupt / stale-schema / unregistered-knob
+    profiles are ignored with a counted event (tuned_profile_rejects) —
+    a bad profile must degrade to built-in defaults, never to a crash or
+    a half-applied config."""
+    global _applied, _applied_count, _counted
+    from mythril_tpu.smt.solver.statistics import SolverStatistics
+
+    stats = SolverStatistics()
+    if _applied and not force:
+        # the serve path applies BEFORE any analyzer enables the stats
+        # singleton — the repeat call from fire_lasers (stats now live)
+        # back-fills the count exactly once, so tuned_knobs_applied can
+        # never read 0 while the knob stamp says source=tuned
+        if _applied_count and not _counted and stats.enabled:
+            stats.add_tuned_knobs_applied(_applied_count)
+            _counted = True
+        return 0
+    _applied = True
+    if not autotune_enabled():
+        return 0
+    from mythril_tpu.tune import space
+
+    platform = platform or default_platform()
+    if not platform:
+        # unknown/ungrounded platform: built-in defaults, never a
+        # cross-platform profile
+        return 0
+    from mythril_tpu.service.calibration import load_tuned
+
+    entry, reject = load_tuned(platform)
+    if reject is not None:
+        stats.add_tuned_profile_reject()
+        log.warning("tuned profile for %s ignored (%s); built-in "
+                    "defaults apply", platform, reject)
+        return 0
+    if entry is None:
+        return 0
+    knobs = entry.get("knobs") or {}
+    if not space.validate_knobs(knobs):
+        stats.add_tuned_profile_reject()
+        log.warning("tuned profile for %s names unregistered or "
+                    "malformed knobs; ignored", platform)
+        return 0
+    from mythril_tpu.support import env as env_mod
+
+    env_mod.set_tuned(dict(knobs))
+    # an explicit env var shadows its tuned knob — count what actually
+    # took effect, so stats can say "N tuned knobs live this run"
+    applied = sum(1 for name in knobs if os.environ.get(name) is None)
+    _applied_count = applied
+    _counted = stats.enabled
+    stats.add_tuned_knobs_applied(applied)
+    log.info("tuned profile applied for %s: %d knob(s) (%d shadowed by "
+             "explicit env), tuned at rev %s",
+             platform, applied, len(knobs) - applied,
+             entry.get("git_rev", "unknown"))
+    return applied

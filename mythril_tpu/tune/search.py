@@ -1,0 +1,435 @@
+"""Measured schedule search over the knob space (`mythril_tpu autotune`).
+
+The TVM pattern closed end-to-end: instead of hand-picked env defaults,
+candidate configurations are MEASURED against a bounded probe workload
+(committed bench inputs by default) and the per-platform winner persists
+beside the calibration profile. Design constraints, in order:
+
+  soundness   a hard findings-parity guard: any candidate whose probe
+              findings are not byte-identical to the default config's is
+              rejected and counted (autotune_rejected_parity) — its wall
+              never enters the ranking. A tuned profile can make the
+              analyzer faster, never different.
+  direction   the search is gap-directed, not blind: candidates are
+              proposed knob-by-knob in the order of the baseline run's
+              `sol_gaps` roofline ranking (space.gap_ordered), so the
+              budget is spent where the measured recoverable seconds are.
+  bound       every candidate runs in a subprocess under a per-candidate
+              wall budget (a pathological config times out and is
+              rejected, it cannot hang the search); successive halving
+              re-measures only the surviving half each round, so noise
+              is spent on the configs that might win.
+  provenance  the persisted profile carries the probe corpus digest, git
+              revision, platform, per-knob before/after and the measured
+              delta — a later `autotune` run on the same probe skips the
+              search (the profile answers it), and bench's
+              tuned_vs_default leg re-validates the claim every round.
+
+The probe objective is end-to-end analyze wall on the probe inputs
+(solver wall is reported alongside): the number the trajectory table
+tracks, not a proxy.
+"""
+
+import glob
+import hashlib
+import json
+import logging
+import math
+import os
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+from mythril_tpu.support.env import env_float, env_int
+from mythril_tpu.tune import (
+    BUDGET_ENV,
+    CANDIDATES_ENV,
+    MIN_DELTA_ENV,
+    default_platform,
+)
+
+log = logging.getLogger(__name__)
+
+DEFAULT_BUDGET_S = 180.0     # per-candidate subprocess wall budget
+DEFAULT_CANDIDATES = 8
+DEFAULT_ROUNDS = 2           # successive-halving measurement rounds
+# minimum relative improvement over baseline before a winner persists —
+# below this the delta is probe noise, and a noise-tuned profile would
+# thrash on every re-tune
+DEFAULT_MIN_DELTA = 0.02
+
+
+class Measurement(NamedTuple):
+    ok: bool
+    wall_s: float
+    solver_wall_s: float
+    findings: Tuple[str, ...]   # FULL per-issue JSON, sorted (the guard)
+    canonical: Tuple[str, ...]  # witness-masked (diagnosis only: a
+    #   parity reject whose canonical row still matches is benign
+    #   witness drift, not a soundness failure — reported, still
+    #   rejected, the hard guard stays byte-identical)
+    stats: dict
+    fail: str                   # "" | timeout | rc=N | unparseable
+
+
+def _canonical_findings(issues) -> Tuple[str, ...]:
+    """Witness-masked canonical rows (same masking as tools/soak_serve:
+    a different schedule may pick a different — equally valid — witness
+    model; input/value/origin of tx steps are solver-chosen)."""
+    issues = json.loads(json.dumps(issues))
+    for issue in issues:
+        for step in (issue.get("tx_sequence") or {}).get("steps", ()):
+            step["input"] = f"<{len(step.get('input', '')) // 2}B>"
+            step["value"] = "<witness>"
+            step["origin"] = "<witness>"
+    return tuple(sorted(
+        json.dumps(issue, sort_keys=True) for issue in issues))
+
+
+class Candidate:
+    __slots__ = ("knobs", "label", "stage", "walls", "parity_ok",
+                 "witness_drift", "fail")
+
+    def __init__(self, knobs: Dict[str, object], label: str, stage: str):
+        self.knobs = knobs
+        self.label = label
+        self.stage = stage
+        self.walls: List[float] = []
+        self.parity_ok = True
+        self.witness_drift = False  # parity reject whose witness-masked
+        #   canonical rows still matched (benign model choice)
+        self.fail = ""
+
+    @property
+    def mean_wall(self) -> float:
+        return sum(self.walls) / len(self.walls) if self.walls else math.inf
+
+
+def default_probe_inputs(repo_root: Optional[str] = None) -> List[str]:
+    """The committed probe corpus: bench_inputs/corpus/*.hex (pinned by
+    tools/make_corpus.py). Bounded to the first two files — the probe
+    must stay cheap enough to run once per candidate."""
+    root = repo_root or os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    files = sorted(glob.glob(
+        os.path.join(root, "bench_inputs", "corpus", "*.hex")))
+    return files[:2]
+
+
+def probe_digest(paths: Sequence[str], tx_count: int,
+                 extra_args: Sequence[str] = ()) -> str:
+    """Content digest of the probe workload — the provenance key that
+    says what a tuned profile's measured delta was measured ON."""
+    digest = hashlib.sha256()
+    digest.update(f"t{tx_count}|{','.join(extra_args)}".encode())
+    for path in paths:
+        try:
+            with open(path, "rb") as fd:
+                digest.update(fd.read())
+        except OSError:
+            digest.update(f"missing:{os.path.basename(path)}".encode())
+    return digest.hexdigest()[:16]
+
+
+def subprocess_runner(inputs: Sequence[str], tx_count: int,
+                      extra_args: Sequence[str], knobs: Dict[str, object],
+                      budget_s: float) -> Measurement:
+    """One probe run in a subprocess: the candidate knobs ride as env
+    vars (the same seam a tuned profile uses), MYTHRIL_TPU_AUTOTUNE=0
+    pins the run to exactly the candidate config (an already-persisted
+    profile must not stack underneath the measurement)."""
+    argv = [sys.executable, "-m", "mythril_tpu", "analyze"]
+    for path in inputs:
+        argv += ["-f", path]
+    argv += ["-t", str(tx_count), "-o", "json",
+             "--solver-timeout", "10000", "--solver-backend", "tpu"]
+    argv += list(extra_args)
+    fd, stats_path = tempfile.mkstemp(suffix=".json")
+    os.close(fd)
+    env = {**os.environ,
+           "MYTHRIL_TPU_AUTOTUNE": "0",
+           "MYTHRIL_TPU_STATS_JSON": stats_path,
+           **{name: str(value) for name, value in knobs.items()}}
+    start = time.monotonic()
+    try:
+        proc = subprocess.run(argv, capture_output=True, text=True,
+                              timeout=budget_s, env=env)
+    except subprocess.TimeoutExpired:
+        return Measurement(False, budget_s, 0.0, (), (), {}, "timeout")
+    except (OSError, subprocess.SubprocessError) as error:
+        return Measurement(False, 0.0, 0.0, (), (), {},
+                           f"oserror:{error}")
+    finally:
+        stats = {}
+        try:
+            with open(stats_path) as handle:
+                stats = json.load(handle)
+        except (OSError, ValueError):
+            stats = {}
+        try:
+            os.unlink(stats_path)
+        except OSError:
+            pass
+    wall = time.monotonic() - start
+    if proc.returncode not in (0, 1):   # 1 = issues found (success case)
+        return Measurement(False, wall, 0.0, (), (), stats,
+                           f"rc={proc.returncode}")
+    try:
+        issues = json.loads(proc.stdout.strip().splitlines()[-1])["issues"]
+        findings = tuple(sorted(
+            json.dumps(issue, sort_keys=True) for issue in issues))
+    except Exception:
+        return Measurement(False, wall, 0.0, (), (), stats, "unparseable")
+    return Measurement(True, wall,
+                       float(stats.get("solver_time", 0.0) or 0.0),
+                       findings, _canonical_findings(issues), stats, "")
+
+
+def propose_candidates(gap_stages: Sequence[str],
+                       limit: int) -> List[Candidate]:
+    """Single-knob candidates in gap order: knobs whose stage tops the
+    baseline's sol_gaps ranking first, each knob contributing its
+    registered candidate values (values equal to the currently-resolved
+    setting are skipped — a no-op config cannot win)."""
+    from mythril_tpu.support.env import resolve_source
+    from mythril_tpu.tune import space
+
+    out: List[Candidate] = []
+    for knob in space.gap_ordered(gap_stages):
+        current, _source = resolve_source(knob.env, knob.default, knob.kind)
+        for value in knob.candidates:
+            if current is not None and value == current:
+                continue
+            out.append(Candidate({knob.env: value},
+                                 f"{knob.env}={value}", knob.stage))
+            if len(out) >= limit:
+                return out
+    return out
+
+
+def run_search(inputs: Sequence[str], tx_count: int,
+               extra_args: Sequence[str] = (),
+               candidates: Optional[int] = None,
+               budget_s: Optional[float] = None,
+               rounds: int = DEFAULT_ROUNDS,
+               min_delta: Optional[float] = None,
+               force: bool = False,
+               runner=subprocess_runner,
+               platform: Optional[str] = None) -> dict:
+    """The whole search: baseline -> gap-directed candidates ->
+    successive halving -> parity-guarded winner -> persisted profile.
+    `runner` is injectable (tests measure deterministically without
+    subprocesses). Returns the summary dict the CLI prints."""
+    from mythril_tpu.observe import metrics
+    from mythril_tpu.service.calibration import load_tuned, save_tuned
+    from mythril_tpu.smt.solver.statistics import SolverStatistics
+    from mythril_tpu.tune import space
+
+    stats = SolverStatistics()
+    n_candidates = candidates if candidates is not None else env_int(
+        CANDIDATES_ENV, DEFAULT_CANDIDATES)
+    budget = budget_s if budget_s is not None else env_float(
+        BUDGET_ENV, DEFAULT_BUDGET_S)
+    min_improvement = min_delta if min_delta is not None else env_float(
+        MIN_DELTA_ENV, DEFAULT_MIN_DELTA)
+    rounds = max(1, rounds)
+    digest = probe_digest(inputs, tx_count, extra_args)
+    # search-side guess only gates the cheap skip check; the baseline
+    # child's initialized jax supplies the authoritative platform
+    guess_platform = platform or default_platform() or "cpu"
+
+    # an existing profile for the same probe answers the search — a
+    # second cold invocation must load, not re-measure (--force re-runs)
+    existing, _reject = load_tuned(guess_platform)
+    if existing is not None and not force \
+            and existing.get("probe_digest") == digest:
+        return {"autotune": "already_tuned", "platform": guess_platform,
+                "probe_digest": digest, "knobs": existing.get("knobs"),
+                "tuned_at": existing.get("tuned_at"),
+                "delta_frac": existing.get("delta_frac")}
+
+    baseline = runner(inputs, tx_count, extra_args, {}, budget)
+    if not baseline.ok:
+        return {"autotune": "baseline_failed", "fail": baseline.fail}
+    measured_platform = baseline.stats.get("platform") or guess_platform
+    if measured_platform != guess_platform:
+        # the probe child's initialized jax is authoritative; re-check
+        # the skip under the platform the profile is actually keyed by
+        # (an unpinned TPU box guesses "cpu" cold but persists "tpu" —
+        # without this the search would re-run forever there)
+        existing, _reject = load_tuned(measured_platform)
+        if existing is not None and not force \
+                and existing.get("probe_digest") == digest:
+            return {"autotune": "already_tuned",
+                    "platform": measured_platform,
+                    "probe_digest": digest,
+                    "knobs": existing.get("knobs"),
+                    "tuned_at": existing.get("tuned_at"),
+                    "delta_frac": existing.get("delta_frac")}
+    baseline_walls = [baseline.wall_s]
+    gap_stages = [row.get("stage") for row in _gap_rows(baseline.stats)]
+
+    pool = propose_candidates(gap_stages, n_candidates)
+    proposed = list(pool)
+    rejected_parity = 0
+    for rnd in range(rounds):
+        for candidate in pool:
+            measurement = runner(inputs, tx_count, extra_args,
+                                 candidate.knobs, budget)
+            if rnd == 0:
+                stats.add_autotune_candidate()
+            if not measurement.ok:
+                candidate.fail = measurement.fail
+                continue
+            if measurement.findings != baseline.findings:
+                # the hard parity guard: rejected and counted, its wall
+                # never ranks (a break in ANY round drops the candidate
+                # for good — it leaves the pool, so no double count)
+                candidate.parity_ok = False
+                candidate.witness_drift = (
+                    bool(measurement.canonical)
+                    and measurement.canonical == baseline.canonical)
+                rejected_parity += 1
+                stats.add_autotune_rejected(parity=True)
+                continue
+            candidate.walls.append(measurement.wall_s)
+        pool = [c for c in pool if c.parity_ok and not c.fail and c.walls]
+        if not pool:
+            break
+        if rnd + 1 < rounds:
+            # successive halving: only the faster half earns another
+            # (noise-reducing) measurement; re-measure baseline alongside
+            pool.sort(key=lambda c: c.mean_wall)
+            pool = pool[:max(1, (len(pool) + 1) // 2)]
+            rebase = runner(inputs, tx_count, extra_args, {}, budget)
+            if rebase.ok and rebase.findings == baseline.findings:
+                baseline_walls.append(rebase.wall_s)
+
+    baseline_wall = sum(baseline_walls) / len(baseline_walls)
+    bar = baseline_wall * (1.0 - min_improvement)
+    pool.sort(key=lambda c: c.mean_wall)
+    winner = pool[0] if pool and pool[0].mean_wall < bar else None
+    # every tried candidate reconciles to exactly one outcome:
+    # candidates_tried == rejected_parity + rejected_regression + winner.
+    # "regression" covers everything measured-but-not-persisted — no
+    # better than the default config within the margin, eliminated by a
+    # halving round, or failed/timed out under the candidate budget.
+    rejected_regression = sum(
+        1 for c in proposed if c.parity_ok and c is not winner)
+    for _ in range(rejected_regression):
+        stats.add_autotune_rejected(parity=False)
+
+    summary = {
+        "autotune": "tuned" if winner else "no_improvement",
+        "platform": measured_platform,
+        "probe_inputs": [os.path.basename(p) for p in inputs],
+        "probe_digest": digest,
+        "baseline_wall_s": round(baseline_wall, 3),
+        "baseline_solver_wall_s": round(baseline.solver_wall_s, 3),
+        "candidates_tried": len(proposed),
+        "rejected_parity": rejected_parity,
+        # of the parity rejects, how many were benign witness drift
+        # (equally valid model choice) rather than a findings change —
+        # rejected either way, but a reader must not mistake drift for
+        # a soundness failure
+        "rejected_witness_drift": sum(
+            1 for c in proposed if c.witness_drift),
+        "rejected_regression": rejected_regression,
+        "rounds": rounds,
+        "budget_s": budget,
+        "gap_stages": gap_stages,
+        "candidates": [
+            {"label": c.label, "stage": c.stage,
+             "mean_wall_s": (round(c.mean_wall, 3)
+                             if c.walls else None),
+             "parity_ok": c.parity_ok,
+             **({"witness_drift": True} if c.witness_drift else {}),
+             **({"fail": c.fail} if c.fail else {})}
+            for c in proposed],
+    }
+    if winner is None:
+        return summary
+
+    knob_deltas = {}
+    from mythril_tpu.support.env import resolve_source
+
+    for name, value in winner.knobs.items():
+        registered = space.knob(name)
+        before, _source = resolve_source(
+            name, registered.default if registered else None,
+            registered.kind if registered else "float")
+        knob_deltas[name] = {
+            "before": before, "after": value,
+            "stage": registered.stage if registered else ""}
+    entry = {
+        "knobs": dict(winner.knobs),
+        "platform": measured_platform,
+        "git_rev": metrics.git_revision(),
+        "probe_digest": digest,
+        "probe_inputs": [os.path.basename(p) for p in inputs],
+        "tx_count": tx_count,
+        "baseline_wall_s": round(baseline_wall, 3),
+        "tuned_wall_s": round(winner.mean_wall, 3),
+        "delta_frac": round(1.0 - winner.mean_wall / baseline_wall, 4),
+        "objective": "probe analyze wall (end-to-end)",
+        "knob_deltas": knob_deltas,
+        "search": {"candidates_tried": len(proposed),
+                   "rejected_parity": rejected_parity,
+                   "rejected_regression": rejected_regression,
+                   "rounds": rounds, "budget_s": budget},
+    }
+    persisted = save_tuned(measured_platform, entry)
+    summary.update({
+        "winner": winner.label,
+        "tuned_wall_s": round(winner.mean_wall, 3),
+        "delta_frac": entry["delta_frac"],
+        "knobs": dict(winner.knobs),
+        "persisted": persisted,
+    })
+    return summary
+
+
+def _gap_rows(stats_payload: dict) -> List[dict]:
+    roofline_section = (stats_payload or {}).get("roofline")
+    if not isinstance(roofline_section, dict):
+        return []
+    from mythril_tpu.observe.roofline import top_gaps
+
+    return top_gaps(roofline_section, n=6)
+
+
+def run_autotune(parsed) -> int:
+    """`mythril_tpu autotune` entry: resolve the probe workload, run the
+    search, print ONE JSON summary line. Exit 0 on a persisted or
+    already-loaded profile (and on an honest no_improvement), 2 on a
+    failed baseline or missing probe."""
+    from mythril_tpu.smt.solver.statistics import SolverStatistics
+
+    stats = SolverStatistics()
+    stats.enabled = True
+    inputs = list(getattr(parsed, "codefile", None) or [])
+    extra_args: List[str] = []
+    if getattr(parsed, "bin_runtime", False):
+        extra_args.append("--bin-runtime")
+    if not inputs:
+        inputs = default_probe_inputs()
+    missing = [path for path in inputs if not os.path.isfile(path)]
+    if not inputs or missing:
+        print(json.dumps({"autotune": "no_probe",
+                          "missing": missing or "bench_inputs/corpus"}))
+        return 2
+    summary = run_search(
+        inputs, getattr(parsed, "transaction_count", 1) or 1,
+        extra_args=extra_args,
+        candidates=getattr(parsed, "candidates", None),
+        budget_s=getattr(parsed, "budget", None),
+        rounds=getattr(parsed, "rounds", None) or DEFAULT_ROUNDS,
+        min_delta=getattr(parsed, "min_delta", None),
+        force=getattr(parsed, "force", False))
+    from mythril_tpu.core import MythrilAnalyzer
+
+    MythrilAnalyzer._dump_stats_json(stats, completed=True)
+    print(json.dumps(summary))
+    return 2 if summary["autotune"] in ("baseline_failed",) else 0

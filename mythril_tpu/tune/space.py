@@ -1,0 +1,126 @@
+"""Declarative knob space for the autotune search.
+
+Every tunable the acceleration stack reads through support/env is
+registered here as a typed Knob: env name, type, built-in default,
+the roofline stage it moves (so the search can be gap-directed — seed
+where `sol_gaps` says the recoverable seconds are, not blind), and the
+candidate values the search may try. The registry is the single source
+of truth for three consumers:
+
+  search.py         proposes (knob, value) candidates in gap order
+  resolved_config() stamps every run's fully-resolved configuration
+                    (value + source tier) into the stats JSON, heartbeat
+                    snapshots, and bench legs
+  tools/check_env_docs.py  every registered knob must have a README
+                    env-table row (lint)
+
+A knob's `default` is the literal built-in where one exists; None marks
+a platform-derived/auto default (e.g. the cube split width is 3 on the
+CPU platform and 7 on a real device) — the stamp then reports None with
+source "default", meaning "the consumer's own auto logic decided".
+`stage` names a roofline stage (observe/roofline.STAGES) where the knob
+moves one, or a coarser subsystem tag ("serve") where it does not.
+"""
+
+from typing import NamedTuple, Optional, Sequence, Tuple
+
+from mythril_tpu.support.env import resolve_source
+
+MIB = 1024 * 1024
+
+
+class Knob(NamedTuple):
+    env: str            # MYTHRIL_TPU_* variable (support/env resolution)
+    kind: str           # "int" | "float"
+    default: Optional[float]   # built-in default; None = platform/auto
+    stage: str          # roofline stage the knob moves (or subsystem tag)
+    candidates: Tuple   # non-default values the search may evaluate
+    help: str
+
+
+KNOBS: Tuple[Knob, ...] = (
+    # kernel stage: what one device round costs and how much work it does
+    Knob("MYTHRIL_TPU_ROUND_BUDGET", "float", 4.0, "kernel",
+         (2.0, 8.0), "target seconds per kernel round"),
+    Knob("MYTHRIL_TPU_RESTARTS", "int", 64, "kernel",
+         (16, 32, 128), "restart lanes per query"),
+    Knob("MYTHRIL_TPU_CIRCUIT_STEPS", "int", 64, "kernel",
+         (32, 128), "SLS steps per kernel round"),
+    Knob("MYTHRIL_TPU_CUBE_VARS", "int", None, "kernel",
+         (2, 4), "cube-and-conquer split width k (2^k cubes)"),
+    Knob("MYTHRIL_TPU_CUBE_MIN_LEVELS", "int", 64, "kernel",
+         (32, 128), "min cone depth for the cube second pass"),
+    Knob("MYTHRIL_TPU_CPU_DISPATCH_CAP", "int", 2, "kernel",
+         (1, 4), "evidence-mode bucketed dispatches per process"),
+    # ragged stage: stream assembly, admission, and window formation
+    Knob("MYTHRIL_TPU_RAGGED_STREAM_BYTES", "int", 48 * MIB, "ragged",
+         (24 * MIB, 96 * MIB), "memory budget per assembled flat stream"),
+    Knob("MYTHRIL_TPU_RAGGED_CHUNK_CONES", "int", 0, "ragged",
+         (2, 4), "cones per mixed-origin stream (0 = measured auto)"),
+    Knob("MYTHRIL_TPU_RAGGED_WINDOW_CAP", "int", 4, "ragged",
+         (2, 8), "evidence-mode ragged stream launches per process"),
+    Knob("MYTHRIL_TPU_COALESCE_MS", "float", 6.0, "ragged",
+         (2.0, 12.0), "coalescing window in milliseconds"),
+    # default None = derived: 16 with bucketed dispatch, 64 when ragged
+    # packing is live (scheduler.DEFAULT_COALESCE_MAX[_RAGGED])
+    Knob("MYTHRIL_TPU_COALESCE_MAX", "int", None, "ragged",
+         (16, 32, 64), "max queries buffered per coalescing window"),
+    # settle stage: the host CDCL's share of the round trip
+    Knob("MYTHRIL_TPU_DEVICE_DEADLINE", "float", None, "settle",
+         (1.0, 5.0), "device budget per dispatch (host-fallback deadline)"),
+    Knob("MYTHRIL_TPU_PREFIX_MEMO_MAX", "int", 32, "settle",
+         (16, 64), "prefix-snapshot memo entries per session"),
+    Knob("MYTHRIL_TPU_SNAPSHOT_NODE_CAP", "int", 200_000, "settle",
+         (100_000, 400_000), "max lowering-cache nodes worth snapshotting"),
+    # serve plane: cross-request batch shape
+    Knob("MYTHRIL_TPU_SERVE_BATCH", "int", 4, "serve",
+         (2, 8), "requests per interleaved serve batch"),
+)
+
+_BY_ENV = {knob.env: knob for knob in KNOBS}
+
+
+def knob(env: str) -> Optional[Knob]:
+    return _BY_ENV.get(env)
+
+
+def knob_names() -> Tuple[str, ...]:
+    return tuple(_BY_ENV)
+
+
+def validate_knobs(mapping) -> bool:
+    """True iff every (name, value) pair names a registered knob with a
+    plausible numeric value — the tuned-profile apply gate."""
+    if not isinstance(mapping, dict) or not mapping:
+        return False
+    for name, value in mapping.items():
+        registered = _BY_ENV.get(name)
+        if registered is None:
+            return False
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            return False
+    return True
+
+
+def resolved_config() -> dict:
+    """{env name: {"value": resolved, "source": env|cli|tuned|default}}
+    for every registered knob — the configuration stamp the stats JSON,
+    heartbeat snapshots, and bench legs carry so every trajectory row is
+    attributable to the config that produced it."""
+    out = {}
+    for entry in KNOBS:
+        value, source = resolve_source(entry.env, entry.default, entry.kind)
+        out[entry.env] = {"value": value, "source": source}
+    return out
+
+
+def gap_ordered(stages: Sequence[str]) -> Tuple[Knob, ...]:
+    """Knobs reordered by the given roofline gap ranking: knobs whose
+    stage appears in `stages` come first (in that stage order, registry
+    order within a stage), everything else after in registry order — the
+    search evaluates where the measured gap is before it evaluates
+    anywhere else."""
+    rank = {stage: idx for idx, stage in enumerate(stages)}
+    return tuple(sorted(
+        KNOBS, key=lambda k: (rank.get(k.stage, len(rank)),
+                              KNOBS.index(k))))

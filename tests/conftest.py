@@ -21,6 +21,11 @@ except Exception:
 # small restart batch: keeps device-solver jit shapes tiny on the CPU
 # platform (hard assignment — ambient env must not win here either)
 os.environ["MYTHRIL_TPU_RESTARTS"] = "16"
+# a tuned profile persisted on THIS machine (~/.cache/mythril_tpu, by a
+# previous `mythril_tpu autotune`) must never leak into tier-1: tests
+# that exercise profile application opt back in with their own isolated
+# MYTHRIL_TPU_CACHE_DIR (hard assignment, same reasoning as above)
+os.environ["MYTHRIL_TPU_AUTOTUNE"] = "0"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (

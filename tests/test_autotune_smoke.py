@@ -1,0 +1,253 @@
+"""Tier-1 autotune smoke: the search driver end to end (deterministic
+injected runner), the real `mythril_tpu autotune` CLI on a tiny probe,
+and the cold-start reload path (knob sources reported as `tuned`)."""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+import pytest
+
+from mythril_tpu.service import calibration
+from mythril_tpu.smt.solver.statistics import SolverStatistics
+from mythril_tpu.support import env as env_mod
+from mythril_tpu import tune
+from mythril_tpu.tune import search
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# two-branch ERC20-ish dispatcher: enough constraints that the probe
+# exercises the solver seam, small enough that one run stays ~seconds
+TINY_RUNTIME_HEX = (
+    "60003560e01c8063a9059cbb14601e5760043560243501600055005b"
+    "60443560205500"
+)
+
+
+@pytest.fixture
+def clean_tiers(tmp_path, monkeypatch):
+    monkeypatch.setenv("MYTHRIL_TPU_CACHE_DIR", str(tmp_path))
+    monkeypatch.setenv("MYTHRIL_TPU_AUTOTUNE", "1")
+    env_mod.clear_overrides()
+    tune.reset_applied()
+    yield tmp_path
+    env_mod.clear_overrides()
+    tune.reset_applied()
+
+
+@pytest.fixture
+def stats():
+    s = SolverStatistics()
+    was_enabled = s.enabled
+    s.reset()
+    s.enabled = True
+    yield s
+    s.reset()
+    s.enabled = was_enabled
+
+
+def _fake_runner_factory(calls, fast_knob="MYTHRIL_TPU_CIRCUIT_STEPS",
+                         fast_value=32):
+    """Deterministic probe stand-in: one candidate measures faster than
+    baseline, one knob family breaks findings parity, everything else is
+    slower — the shapes the guard/ranking logic must separate."""
+    baseline_findings = ("issue-a", "issue-b")
+
+    def runner(inputs, tx_count, extra_args, knobs, budget_s):
+        calls.append(dict(knobs))
+        stats_payload = {
+            "platform": "cpu",
+            "roofline": {"stages": {
+                "kernel": {"sol_gap_s": 3.0, "attained": 1.0,
+                           "attainable": 2.0, "units": "cells/s"}}},
+        }
+        if "MYTHRIL_TPU_COALESCE_MS" in knobs:
+            # parity breaker: must be rejected and never ranked. Its
+            # CANONICAL rows match baseline (pure witness drift) so the
+            # reject must be reported as drift, not a findings change.
+            return search.Measurement(True, 1.0, 0.5, ("issue-a",),
+                                      ("canon-a", "canon-b"),
+                                      stats_payload, "")
+        wall = 10.0
+        if knobs.get(fast_knob) == fast_value:
+            wall = 6.0
+        elif knobs:
+            wall = 11.0
+        return search.Measurement(True, wall, 5.0, baseline_findings,
+                                  ("canon-a", "canon-b"),
+                                  stats_payload, "")
+
+    return runner
+
+
+def test_two_candidate_search_persists_and_reloads(clean_tiers, stats):
+    calls = []
+    runner = _fake_runner_factory(calls)
+    summary = search.run_search(
+        ["probe.hex"], 1, candidates=2, budget_s=30.0, rounds=1,
+        runner=runner, platform="cpu")
+    # candidates=2 proposes ROUND_BUDGET=2.0 and 8.0 (kernel-first gap
+    # order); neither beats baseline -> honest no_improvement, counted
+    assert summary["autotune"] == "no_improvement"
+    assert summary["candidates_tried"] == 2
+    assert stats.autotune_candidates_tried == 2
+    assert stats.autotune_rejected_regression == 2
+    assert calibration.load_tuned("cpu") == (None, None)
+
+    # widen to reach the deterministic winner (CIRCUIT_STEPS=32): a
+    # profile must be WRITTEN with full provenance
+    stats.reset()
+    stats.enabled = True
+    summary = search.run_search(
+        ["probe.hex"], 1, candidates=6, budget_s=30.0, rounds=2,
+        runner=runner, platform="cpu")
+    assert summary["autotune"] == "tuned"
+    assert summary["winner"] == "MYTHRIL_TPU_CIRCUIT_STEPS=32"
+    assert summary["persisted"] is True
+    entry, reject = calibration.load_tuned("cpu")
+    assert reject is None
+    assert entry["knobs"] == {"MYTHRIL_TPU_CIRCUIT_STEPS": 32}
+    assert entry["probe_digest"] == summary["probe_digest"]
+    assert entry["delta_frac"] > 0
+    assert entry["knob_deltas"]["MYTHRIL_TPU_CIRCUIT_STEPS"][
+        "after"] == 32
+    assert entry["search"]["candidates_tried"] == 6
+
+    # ...and RELOADED: a second cold invocation answers from the profile
+    # without a single probe run
+    calls.clear()
+    again = search.run_search(
+        ["probe.hex"], 1, candidates=6, budget_s=30.0, rounds=2,
+        runner=runner, platform="cpu")
+    assert again["autotune"] == "already_tuned"
+    assert again["knobs"] == {"MYTHRIL_TPU_CIRCUIT_STEPS": 32}
+    assert calls == []
+
+    # --force re-searches
+    search.run_search(["probe.hex"], 1, candidates=2, budget_s=30.0,
+                      rounds=1, force=True, runner=runner, platform="cpu")
+    assert calls != []
+
+
+def test_parity_breaking_candidate_rejected_and_counted(clean_tiers,
+                                                        stats):
+    calls = []
+    runner = _fake_runner_factory(calls)
+    # take the WHOLE space so the COALESCE_MS parity breaker (ragged
+    # stage, ranked after the kernel knobs) enters the pool
+    summary = search.run_search(
+        ["probe.hex"], 1, candidates=99, budget_s=30.0, rounds=1,
+        runner=runner, platform="cpu")
+    assert summary["rejected_parity"] >= 1
+    assert stats.autotune_rejected_parity == summary["rejected_parity"]
+    rejected = [c for c in summary["candidates"] if not c["parity_ok"]]
+    assert rejected and all(
+        "MYTHRIL_TPU_COALESCE_MS" in c["label"] for c in rejected)
+    # canonical rows matched: the reject is labeled benign witness
+    # drift, not a findings change
+    assert all(c.get("witness_drift") for c in rejected)
+    assert summary["rejected_witness_drift"] == len(rejected)
+    # the parity breaker's (fast) wall never ranked: the winner still
+    # came from the parity-clean pool
+    assert summary["autotune"] == "tuned"
+    assert summary["winner"] == "MYTHRIL_TPU_CIRCUIT_STEPS=32"
+
+
+def test_probe_digest_changes_invalidate_skip(clean_tiers, stats,
+                                              tmp_path):
+    calls = []
+    runner = _fake_runner_factory(calls)
+    probe = tmp_path / "p.hex"
+    probe.write_text("60016002")
+    search.run_search([str(probe)], 1, candidates=6, budget_s=30.0,
+                      rounds=1, runner=runner, platform="cpu")
+    assert calibration.load_tuned("cpu")[0] is not None
+    calls.clear()
+    probe.write_text("60016003")  # the probe corpus changed
+    summary = search.run_search([str(probe)], 1, candidates=6,
+                                budget_s=30.0, rounds=1, runner=runner,
+                                platform="cpu")
+    # a changed digest re-searches instead of trusting the stale claim
+    assert summary["autotune"] in ("tuned", "no_improvement")
+    assert calls != []
+
+
+def test_autotune_cli_end_to_end(tmp_path):
+    """The real CLI: 2-candidate search on a tiny committed-shape input.
+    Asserts the mechanics (exit code, summary shape, counters); whether
+    a winner persists depends on real measured walls, so both outcomes
+    are legal here — determinism of persistence is pinned above."""
+    probe = tmp_path / "tiny.hex"
+    probe.write_text(TINY_RUNTIME_HEX)
+    env = {**os.environ,
+           "MYTHRIL_TPU_CACHE_DIR": str(tmp_path),
+           "MYTHRIL_TPU_AUTOTUNE": "1",
+           "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.run(
+        [sys.executable, "-m", "mythril_tpu", "autotune",
+         "-f", str(probe), "--bin-runtime", "-t", "1",
+         "--candidates", "2", "--rounds", "1", "--budget", "120"],
+        capture_output=True, text=True, timeout=360, env=env,
+        cwd=REPO_ROOT)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    summary = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert summary["autotune"] in ("tuned", "no_improvement")
+    assert summary["candidates_tried"] == 2
+    assert summary["rejected_parity"] == 0
+    if summary["autotune"] == "tuned":
+        entry, reject = _load_tuned_from(str(tmp_path),
+                                         summary["platform"])
+        assert reject is None and entry["knobs"] == summary["knobs"]
+
+
+def _load_tuned_from(cache_dir, platform):
+    with open(os.path.join(cache_dir, "calibration.json")) as fd:
+        payload = json.load(fd)
+    entry = payload.get("tuned", {}).get(platform)
+    if entry is None:
+        return None, "absent"
+    return entry, None
+
+
+def test_cold_analyze_reports_tuned_sources(tmp_path):
+    """The acceptance path: a persisted profile + a COLD analyze child
+    -> the stats JSON reports the knob sources as `tuned` and counts
+    tuned_knobs_applied, with no search in sight."""
+    cache_dir = tmp_path / "cache"
+    cache_dir.mkdir()
+    os.environ["MYTHRIL_TPU_CACHE_DIR"] = str(cache_dir)
+    try:
+        assert calibration.save_tuned("cpu", {
+            "knobs": {"MYTHRIL_TPU_ROUND_BUDGET": 2.0,
+                      "MYTHRIL_TPU_COALESCE_MAX": 32},
+            "probe_digest": "smoke"})
+    finally:
+        os.environ.pop("MYTHRIL_TPU_CACHE_DIR", None)
+    probe = tmp_path / "tiny.hex"
+    probe.write_text(TINY_RUNTIME_HEX)
+    stats_path = tmp_path / "stats.json"
+    env = {**os.environ,
+           "MYTHRIL_TPU_CACHE_DIR": str(cache_dir),
+           "MYTHRIL_TPU_AUTOTUNE": "1",
+           "MYTHRIL_TPU_STATS_JSON": str(stats_path),
+           "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.run(
+        [sys.executable, "-m", "mythril_tpu", "analyze",
+         "-f", str(probe), "--bin-runtime", "-t", "1", "-o", "json",
+         "--solver-timeout", "5000"],
+        capture_output=True, text=True, timeout=240, env=env,
+        cwd=REPO_ROOT)
+    assert proc.returncode in (0, 1), proc.stderr[-2000:]
+    with open(stats_path) as fd:
+        stats_payload = json.load(fd)
+    assert stats_payload["tuned_knobs_applied"] == 2
+    assert stats_payload["tuned_profile_rejects"] == 0
+    knobs = stats_payload["knobs"]
+    assert knobs["MYTHRIL_TPU_ROUND_BUDGET"] == {
+        "value": 2.0, "source": "tuned"}
+    assert knobs["MYTHRIL_TPU_COALESCE_MAX"] == {
+        "value": 32, "source": "tuned"}
+    # untuned knobs still report their built-in default
+    assert knobs["MYTHRIL_TPU_SERVE_BATCH"]["source"] == "default"

@@ -1,0 +1,399 @@
+"""Autotune subsystem: knob-space resolution precedence, tuned-profile
+persistence/application/invalidation, the configuration stamp, and the
+measured ragged-chunk auto default."""
+
+import json
+import os
+
+import pytest
+
+from mythril_tpu.service import calibration
+from mythril_tpu.smt.solver.statistics import SolverStatistics
+from mythril_tpu.support import env as env_mod
+from mythril_tpu import tune
+from mythril_tpu.tune import space
+
+
+@pytest.fixture
+def stats():
+    s = SolverStatistics()
+    was_enabled = s.enabled
+    s.reset()
+    s.enabled = True
+    yield s
+    s.reset()
+    s.enabled = was_enabled
+
+
+@pytest.fixture
+def clean_tiers(tmp_path, monkeypatch):
+    """Isolated cache dir + empty tuned/cli tiers + re-appliable profile,
+    with MYTHRIL_TPU_AUTOTUNE re-enabled (conftest hard-disables it so
+    an ambient machine profile can never leak into tier-1)."""
+    monkeypatch.setenv("MYTHRIL_TPU_CACHE_DIR", str(tmp_path))
+    monkeypatch.setenv("MYTHRIL_TPU_AUTOTUNE", "1")
+    env_mod.clear_overrides()
+    tune.reset_applied()
+    yield tmp_path
+    env_mod.clear_overrides()
+    tune.reset_applied()
+
+
+# -- resolution precedence ----------------------------------------------------
+
+
+def test_env_beats_cli_beats_tuned_beats_default(monkeypatch):
+    env_mod.clear_overrides()
+    name = "MYTHRIL_TPU_ROUND_BUDGET"
+    try:
+        assert env_mod.env_float(name, 4.0) == 4.0
+        assert env_mod.resolve_source(name, 4.0) == (4.0, "default")
+        env_mod.set_tuned({name: 2.0})
+        assert env_mod.env_float(name, 4.0) == 2.0
+        assert env_mod.resolve_source(name, 4.0) == (2.0, "tuned")
+        env_mod.set_cli(name, 3.0)
+        assert env_mod.env_float(name, 4.0) == 3.0
+        assert env_mod.resolve_source(name, 4.0) == (3.0, "cli")
+        monkeypatch.setenv(name, "9.5")
+        assert env_mod.env_float(name, 4.0) == 9.5
+        assert env_mod.resolve_source(name, 4.0) == (9.5, "env")
+    finally:
+        env_mod.clear_overrides()
+
+
+def test_malformed_values_degrade_safely(monkeypatch):
+    env_mod.clear_overrides()
+    name = "MYTHRIL_TPU_COALESCE_MAX"
+    try:
+        # a PRESENT-but-malformed env var pins the built-in default: an
+        # explicit env var (even a broken/empty one) is absolute and
+        # must never be silently replaced by a tuned value
+        monkeypatch.setenv(name, "not-a-number")
+        env_mod.set_tuned({name: 32})
+        assert env_mod.env_int(name, 16) == 16
+        monkeypatch.setenv(name, "")
+        assert env_mod.env_int(name, 16) == 16
+        # a malformed TUNED entry falls through to the default
+        monkeypatch.delenv(name)
+        env_mod.set_tuned({name: "also-bad"})
+        assert env_mod.env_int(name, 16) == 16
+        env_mod.set_tuned({name: 32})
+        assert env_mod.env_int(name, 16) == 32
+    finally:
+        env_mod.clear_overrides()
+
+
+def test_env_int_accepts_json_roundtripped_floats():
+    env_mod.clear_overrides()
+    try:
+        env_mod.set_tuned({"MYTHRIL_TPU_SERVE_BATCH": 8.0})
+        value = env_mod.env_int("MYTHRIL_TPU_SERVE_BATCH", 4)
+        assert value == 8 and isinstance(value, int)
+    finally:
+        env_mod.clear_overrides()
+
+
+# -- knob space ---------------------------------------------------------------
+
+
+def test_every_knob_is_well_formed():
+    assert len(space.KNOBS) >= 12
+    for knob in space.KNOBS:
+        assert knob.env.startswith("MYTHRIL_TPU_")
+        assert knob.kind in ("int", "float")
+        assert knob.candidates, knob.env
+    assert len(set(space.knob_names())) == len(space.KNOBS)
+
+
+def test_gap_ordered_puts_ranked_stages_first():
+    ordered = space.gap_ordered(["ragged", "kernel"])
+    stages = [knob.stage for knob in ordered]
+    first_ragged = stages.index("ragged")
+    first_kernel = stages.index("kernel")
+    first_other = min(i for i, s in enumerate(stages)
+                      if s not in ("ragged", "kernel"))
+    assert first_ragged < first_kernel < first_other
+
+
+def test_resolved_config_reports_sources(monkeypatch):
+    env_mod.clear_overrides()
+    try:
+        monkeypatch.setenv("MYTHRIL_TPU_COALESCE_MS", "3")
+        env_mod.set_tuned({"MYTHRIL_TPU_ROUND_BUDGET": 2.0})
+        cfg = space.resolved_config()
+        assert set(cfg) == set(space.knob_names())
+        assert cfg["MYTHRIL_TPU_COALESCE_MS"] == {
+            "value": 3.0, "source": "env"}
+        assert cfg["MYTHRIL_TPU_ROUND_BUDGET"] == {
+            "value": 2.0, "source": "tuned"}
+        assert cfg["MYTHRIL_TPU_SERVE_BATCH"]["source"] == "default"
+    finally:
+        env_mod.clear_overrides()
+
+
+def test_validate_knobs_rejects_garbage():
+    assert space.validate_knobs({"MYTHRIL_TPU_ROUND_BUDGET": 2.0})
+    assert not space.validate_knobs({})
+    assert not space.validate_knobs({"NOT_A_KNOB": 1})
+    assert not space.validate_knobs({"MYTHRIL_TPU_ROUND_BUDGET": "2"})
+    assert not space.validate_knobs({"MYTHRIL_TPU_ROUND_BUDGET": True})
+    assert not space.validate_knobs("nope")
+
+
+# -- persistence + application ------------------------------------------------
+
+
+def test_tuned_profile_roundtrip_with_provenance(clean_tiers):
+    entry = {"knobs": {"MYTHRIL_TPU_ROUND_BUDGET": 2.0},
+             "probe_digest": "abcd", "git_rev": "deadbeef",
+             "delta_frac": 0.25}
+    assert calibration.save_tuned("cpu", entry)
+    loaded, reject = calibration.load_tuned("cpu")
+    assert reject is None
+    assert loaded["knobs"] == {"MYTHRIL_TPU_ROUND_BUDGET": 2.0}
+    assert loaded["probe_digest"] == "abcd"
+    assert loaded["schema"] == calibration.TUNED_SCHEMA_VERSION
+    assert loaded["tuned_at"] > 0
+    # other platforms stay untuned
+    assert calibration.load_tuned("tpu") == (None, None)
+    assert calibration.load_tuned(None) == (None, None)
+
+
+def test_apply_installs_tuned_tier_and_counts(clean_tiers, stats):
+    calibration.save_tuned("cpu", {
+        "knobs": {"MYTHRIL_TPU_ROUND_BUDGET": 2.0,
+                  "MYTHRIL_TPU_SERVE_BATCH": 8}})
+    applied = tune.apply_tuned_profile(platform="cpu")
+    assert applied == 2
+    assert stats.tuned_knobs_applied == 2
+    assert env_mod.env_float("MYTHRIL_TPU_ROUND_BUDGET", 4.0) == 2.0
+    cfg = space.resolved_config()
+    assert cfg["MYTHRIL_TPU_ROUND_BUDGET"]["source"] == "tuned"
+    assert cfg["MYTHRIL_TPU_SERVE_BATCH"] == {"value": 8,
+                                              "source": "tuned"}
+    # one-shot per process: a second apply is a no-op
+    assert tune.apply_tuned_profile(platform="cpu") == 0
+    assert stats.tuned_knobs_applied == 2
+
+
+def test_explicit_env_shadows_tuned_knob(clean_tiers, stats, monkeypatch):
+    calibration.save_tuned("cpu", {
+        "knobs": {"MYTHRIL_TPU_ROUND_BUDGET": 2.0,
+                  "MYTHRIL_TPU_SERVE_BATCH": 8}})
+    monkeypatch.setenv("MYTHRIL_TPU_ROUND_BUDGET", "7.5")
+    applied = tune.apply_tuned_profile(platform="cpu")
+    # only the unshadowed knob counts as live
+    assert applied == 1
+    assert env_mod.env_float("MYTHRIL_TPU_ROUND_BUDGET", 4.0) == 7.5
+    assert space.resolved_config()["MYTHRIL_TPU_ROUND_BUDGET"][
+        "source"] == "env"
+
+
+def test_autotune_env_zero_disables_application(clean_tiers, stats,
+                                                monkeypatch):
+    calibration.save_tuned("cpu", {
+        "knobs": {"MYTHRIL_TPU_ROUND_BUDGET": 2.0}})
+    monkeypatch.setenv("MYTHRIL_TPU_AUTOTUNE", "0")
+    assert tune.apply_tuned_profile(platform="cpu") == 0
+    assert env_mod.env_float("MYTHRIL_TPU_ROUND_BUDGET", 4.0) == 4.0
+
+
+def test_corrupt_profile_ignored_with_counted_event(clean_tiers, stats):
+    path = os.path.join(str(clean_tiers), "calibration.json")
+    with open(path, "w") as fd:
+        fd.write("{ torn json")
+    assert tune.apply_tuned_profile(platform="cpu") == 0
+    assert stats.tuned_profile_rejects == 1
+    assert env_mod.tuned_values() == {}
+
+
+def test_stale_schema_profile_ignored_with_counted_event(clean_tiers,
+                                                         stats):
+    calibration.save_tuned("cpu", {
+        "knobs": {"MYTHRIL_TPU_ROUND_BUDGET": 2.0}})
+    path = os.path.join(str(clean_tiers), "calibration.json")
+    with open(path) as fd:
+        payload = json.load(fd)
+    payload["tuned"]["cpu"]["schema"] = calibration.TUNED_SCHEMA_VERSION + 1
+    with open(path, "w") as fd:
+        json.dump(payload, fd)
+    assert calibration.load_tuned("cpu") == (None, "stale-schema")
+    assert tune.apply_tuned_profile(platform="cpu") == 0
+    assert stats.tuned_profile_rejects == 1
+
+
+def test_unregistered_knob_profile_rejected(clean_tiers, stats):
+    calibration.save_tuned("cpu", {"knobs": {"MYTHRIL_TPU_NOT_REAL": 3}})
+    assert tune.apply_tuned_profile(platform="cpu") == 0
+    assert stats.tuned_profile_rejects == 1
+    assert env_mod.tuned_values() == {}
+
+
+def test_clear_caches_keeps_tuned_profile(clean_tiers, stats):
+    from mythril_tpu.support.model import clear_caches
+
+    calibration.save_tuned("cpu", {
+        "knobs": {"MYTHRIL_TPU_ROUND_BUDGET": 2.0}})
+    assert tune.apply_tuned_profile(platform="cpu") == 1
+    clear_caches()
+    # the applied tier survives in-process cache clears...
+    assert space.resolved_config()["MYTHRIL_TPU_ROUND_BUDGET"][
+        "source"] == "tuned"
+    # ...and the persisted section survives on disk for the next process
+    loaded, reject = calibration.load_tuned("cpu")
+    assert reject is None
+    assert loaded["knobs"] == {"MYTHRIL_TPU_ROUND_BUDGET": 2.0}
+
+
+def test_save_profile_preserves_tuned_section(clean_tiers, monkeypatch):
+    from mythril_tpu.support.args import args
+
+    calibration.save_tuned("cpu", {
+        "knobs": {"MYTHRIL_TPU_ROUND_BUDGET": 2.0}})
+    monkeypatch.setattr(args, "solve_cache", "disk")
+    calibration.save_profile("cpu", 8, 32,
+                             {"per_cell_s": 1e-9, "compile_s": 0.4})
+    profile = calibration.load_profile("cpu", 8, 32)
+    assert profile["per_cell_s"] == 1e-9
+    assert profile["compile_s"] == 0.4
+    loaded, reject = calibration.load_tuned("cpu")
+    assert reject is None and loaded["knobs"]
+
+
+def test_late_stats_enable_backfills_applied_count(clean_tiers):
+    """The serve path applies the profile BEFORE fire_lasers enables the
+    stats singleton: the count must back-fill on the next (no-op) apply
+    instead of reading 0 forever while the knob stamp says tuned."""
+    calibration.save_tuned("cpu", {
+        "knobs": {"MYTHRIL_TPU_ROUND_BUDGET": 2.0}})
+    s = SolverStatistics()
+    was_enabled = s.enabled
+    s.reset()
+    s.enabled = False
+    try:
+        assert tune.apply_tuned_profile(platform="cpu") == 1
+        assert s.tuned_knobs_applied == 0  # dropped: stats disabled
+        s.enabled = True
+        assert tune.apply_tuned_profile(platform="cpu") == 0  # one-shot
+        assert s.tuned_knobs_applied == 1  # back-filled exactly once
+        tune.apply_tuned_profile(platform="cpu")
+        assert s.tuned_knobs_applied == 1
+    finally:
+        s.reset()
+        s.enabled = was_enabled
+
+
+def test_default_platform_falls_back_to_single_tuned_entry(
+        clean_tiers, monkeypatch):
+    """Unpinned process, jax not initialized: the one platform ever
+    tuned (measured by the probe children's initialized jax) is the
+    right guess — without it a TPU box would guess 'cpu' cold and the
+    persisted 'tpu' profile would never apply."""
+    monkeypatch.setattr("mythril_tpu.observe.metrics.jax_platform",
+                        lambda: None)
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    assert tune.default_platform() is None  # nothing tuned -> unknown
+    calibration.save_tuned("tpu", {
+        "knobs": {"MYTHRIL_TPU_ROUND_BUDGET": 2.0}})
+    assert tune.default_platform() == "tpu"
+    # two entries = ambiguous: unknown, and NO profile applies
+    calibration.save_tuned("cpu", {
+        "knobs": {"MYTHRIL_TPU_ROUND_BUDGET": 3.0}})
+    assert tune.default_platform() is None
+    assert tune.apply_tuned_profile() == 0
+    assert env_mod.tuned_values() == {}
+
+
+def test_single_entry_fallback_needs_measurement_agreement(
+        clean_tiers, monkeypatch):
+    """A cpu-only tuned section on a box whose own calibration
+    measurements say 'tpu' is a cross-platform profile: the ungrounded
+    guess must apply nothing rather than let a cpu-measured schedule
+    govern TPU execution."""
+    from mythril_tpu.support.args import args
+
+    monkeypatch.setattr("mythril_tpu.observe.metrics.jax_platform",
+                        lambda: None)
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    calibration.save_tuned("cpu", {
+        "knobs": {"MYTHRIL_TPU_ROUND_BUDGET": 2.0}})
+    monkeypatch.setattr(args, "solve_cache", "disk")
+    calibration.save_profile("tpu", 64, 64, {"per_cell_s": 1e-9})
+    assert calibration.measured_platforms() == ["tpu"]
+    assert tune.default_platform() is None
+    assert tune.apply_tuned_profile() == 0
+    # agreement (cpu measurements too... but tpu still present) stays
+    # ungrounded; only a consistent single-platform history grounds it
+    calibration.save_profile("cpu", 8, 32, {"per_cell_s": 1e-9})
+    assert tune.default_platform() is None
+
+
+# -- configuration stamp ------------------------------------------------------
+
+
+def test_stats_json_and_heartbeat_carry_knob_stamp(stats):
+    env_mod.clear_overrides()
+    try:
+        env_mod.set_tuned({"MYTHRIL_TPU_COALESCE_MAX": 32})
+        payload = stats.as_dict()
+        assert payload["knobs"]["MYTHRIL_TPU_COALESCE_MAX"] == {
+            "value": 32, "source": "tuned"}
+        from mythril_tpu.observe import metrics
+
+        snap = metrics.snapshot()
+        assert snap["knobs"]["MYTHRIL_TPU_COALESCE_MAX"][
+            "source"] == "tuned"
+        assert set(snap["knobs"]) == set(space.knob_names())
+    finally:
+        env_mod.clear_overrides()
+
+
+# -- measured ragged-chunk auto default ---------------------------------------
+
+
+class _StubBackend:
+    num_restarts = 8
+    CIRCUIT_STEPS = 32
+
+    def _modules(self):
+        raise RuntimeError("no jax in this test")
+
+
+def _router(monkeypatch, platform="cpu"):
+    from mythril_tpu.tpu.router import QueryRouter
+
+    router = QueryRouter(_StubBackend())
+    monkeypatch.setattr(router, "_platform", lambda: platform)
+    return router
+
+
+def test_auto_chunk_cones_derived_from_compile_ratio(monkeypatch):
+    router = _router(monkeypatch)
+    # no measured compile cost: the measured-in-PR-12 floor stands
+    assert router._auto_chunk_cones() == 2
+    # deadline 2.5 s (cpu default), compile 0.25 s -> 2.5/(2*0.25) = 5
+    router._compile_s = 0.25
+    assert router._auto_chunk_cones() == 5
+    # fast compile: clamped at 8, never unbounded in evidence mode
+    router._compile_s = 0.01
+    assert router._auto_chunk_cones() == 8
+    # slow compile: never under the floor of 2
+    router._compile_s = 10.0
+    assert router._auto_chunk_cones() == 2
+
+
+def test_env_override_stays_absolute_over_auto(monkeypatch):
+    monkeypatch.setenv("MYTHRIL_TPU_RAGGED_CHUNK_CONES", "3")
+    router = _router(monkeypatch)
+    router._compile_s = 0.01  # auto would say 8
+    assert router.ragged_chunk_cones == 3
+
+
+def test_calibration_cache_roundtrips_compile_s(clean_tiers, monkeypatch):
+    from mythril_tpu.support.args import args
+
+    monkeypatch.setattr(args, "solve_cache", "disk")
+    calibration.save_profile("cpu", 8, 32,
+                             {"per_cell_s": 2e-9, "compile_s": 0.75})
+    profile = calibration.load_profile("cpu", 8, 32)
+    assert profile["compile_s"] == 0.75

@@ -71,6 +71,11 @@ TRAJECTORY_METRICS = (
     # (contamination / dirty drain) would be a regression
     "serve.warm_requests_per_hour",
     "serve.zero_contamination",
+    # autotune loop: the tuned-vs-default paired leg — speedup dropping
+    # (or findings parity flipping) means the persisted profile went
+    # stale and must be re-tuned; the trajectory table catches it
+    "tuned.speedup",
+    "tuned.findings_equal",
 )
 
 _HIGHER_BETTER_RE = re.compile(
@@ -83,7 +88,10 @@ _HIGHER_BETTER_RE = re.compile(
     # mixed-origin windows both want to go UP
     r"|per_hour|xcontract"
     # serve daemon: containment verdicts flipping false is a regression
-    r"|zero_contamination|clean_drain)")
+    r"|zero_contamination|clean_drain"
+    # autotune: the tuned profile going dark (knobs_applied -> 0)
+    # silently reverts every leg to built-in defaults
+    r"|knobs_applied)")
 _LOWER_BETTER_RE = re.compile(
     r"(_s$|wall|cap_rejects|cdcl_settles|sol_gap|misses|fallbacks"
     r"|verify_rejects|degraded|deadline_trips|breaker_trips)")
@@ -201,6 +209,14 @@ def extract_metrics(payload: dict) -> Dict[str, object]:
     put("serve.p99_admission_s", serve.get("p99_admission_s"))
     put("serve.zero_contamination", serve.get("zero_contamination"))
     put("serve.clean_drain", serve.get("clean_drain"))
+    tuned = extra.get("tuned_vs_default") or {}
+    put("tuned.default_wall_s", tuned.get("default_wall_s"))
+    put("tuned.tuned_wall_s", tuned.get("tuned_wall_s"))
+    put("tuned.speedup", tuned.get("speedup"))
+    put("tuned.solver_wall_s", tuned.get("tuned_solver_wall_s"))
+    put("tuned.contracts_per_hour", tuned.get("contracts_per_hour_tuned"))
+    put("tuned.findings_equal", tuned.get("findings_equal"))
+    put("tuned.knobs_applied", tuned.get("tuned_knobs_applied"))
     xcontract = extra.get("corpus_xcontract") or {}
     put("xcontract.contracts_per_hour",
         xcontract.get("contracts_per_hour"))

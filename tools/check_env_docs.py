@@ -64,6 +64,31 @@ def main(argv) -> int:
     documented = documented_env_vars(readme)
     missing = sorted(used - documented)
     stale = sorted(documented - used)
+    # every knob REGISTERED in the autotune space must have a README row
+    # — stricter than the textual scan (a knob could be registered via a
+    # constant the scan would still catch, but the import-based check
+    # keeps the invariant explicit and survives refactors). Gated on the
+    # scanned root actually shipping a tune space: the lint's own tests
+    # run it against synthetic trees that have none.
+    unregistered = []
+    if os.path.isfile(os.path.join(package_dir, "tune", "space.py")):
+        sys.path.insert(0, root)
+        try:
+            from mythril_tpu.tune.space import KNOBS
+
+            unregistered = sorted(
+                knob.env for knob in KNOBS if knob.env not in documented)
+        except Exception as error:  # a broken space is its own failure
+            print(f"FAIL: could not load mythril_tpu.tune.space "
+                  f"({error})", file=sys.stderr)
+            return 1
+    if unregistered:
+        print("FAIL: knobs registered in the autotune space "
+              "(mythril_tpu/tune/space.py) but missing from README.md's "
+              "env-var table:", file=sys.stderr)
+        for name in unregistered:
+            print(f"  {name}", file=sys.stderr)
+        return 1
     if stale:
         print("warning: documented in README but not mentioned under "
               "mythril_tpu/: " + ", ".join(stale), file=sys.stderr)

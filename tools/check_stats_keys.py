@@ -133,6 +133,40 @@ def main(argv) -> int:
             failures.append(
                 f"benchmarked instrument {instrument.name!r} missing "
                 "from bench.py ROUTING_KEYS roll-up")
+    # 7. the autotune loop's own telemetry: every tune counter must be a
+    # real SolverStatistics counter (and therefore — via 1/2 above —
+    # reach the stats JSON and the bench roll-up), and the resolved knob
+    # configuration stamp must flow to both the stats JSON and the
+    # heartbeat snapshot with every registered knob present
+    from mythril_tpu.tune import TUNE_COUNTERS
+    from mythril_tpu.tune import space as tune_space
+
+    for name in TUNE_COUNTERS:
+        if name not in fields:
+            failures.append(
+                f"tune counter {name!r} is not a SolverStatistics field")
+        if name not in emitted:
+            failures.append(
+                f"tune counter {name!r} missing from the stats JSON "
+                "emission (as_dict)")
+        if name not in routed:
+            failures.append(
+                f"tune counter {name!r} missing from bench.py "
+                "ROUTING_KEYS roll-up")
+    for section_name, section in (("as_dict()", emitted_dict.get("knobs")),
+                                  ("metrics.snapshot()",
+                                   snap.get("knobs"))):
+        if not isinstance(section, dict):
+            failures.append(
+                f"{section_name} does not emit the \"knobs\" "
+                "configuration stamp")
+            continue
+        absent = sorted(set(tune_space.knob_names()) - set(section))
+        if absent:
+            failures.append(
+                f"{section_name} \"knobs\" stamp is missing registered "
+                "knobs: " + ", ".join(absent))
+
     registered = {inst.name for inst in metrics.REGISTRY}
     unregistered = sorted(set(fields) - registered)
     if unregistered:
