@@ -64,6 +64,32 @@ def fork_enabled() -> bool:
     return not getattr(args, "no_frontier_fork", False)
 
 
+def symlane_enabled() -> bool:
+    """Symbolic-value lanes in the dense representation
+    (laser/frontier/symlane.py): stack slots may carry opaque
+    term-handles instead of concrete limbs, CALLDATALOAD promotes
+    in-batch, and RETURN/STOP become terminal micro-ops. Registered as
+    an autotune knob (MYTHRIL_TPU_FRONTIER_SYMLANE, default on) on top
+    of the vmap-frontier switch."""
+    if not enabled():
+        return False
+    from mythril_tpu.support.env import env_int
+
+    return env_int("MYTHRIL_TPU_FRONTIER_SYMLANE", 1) != 0
+
+
+def multipc_width() -> int:
+    """Cross-fork re-batching width (MYTHRIL_TPU_FRONTIER_MULTIPC):
+    how many fork-cohort groups — distinct (code-hash, pc) keys of one
+    fork step's successor set — may chain through their next dense run
+    without re-entering the worklist. 0 disables re-batching (every
+    cohort pays the one-iteration worklist stall); default 2 covers
+    both sides of a fork. An autotune knob."""
+    from mythril_tpu.support.env import env_int
+
+    return max(env_int("MYTHRIL_TPU_FRONTIER_MULTIPC", 2), 0)
+
+
 def fork_depth_cap() -> int:
     """MYTHRIL_TPU_FRONTIER_FORK_DEPTH: rows at or past this state depth
     take the per-state JUMPI instead of the batched fork (an operator

@@ -50,35 +50,56 @@ def encodable_word(entry) -> Optional[int]:
     return entry.raw.value
 
 
-def state_encodable(global_state, run: Run) -> bool:
-    """Per-state batch admission for `run` (the stepper has already
-    checked engine-level and code-level conditions)."""
+def state_prechecks(global_state, run: Run):
+    """Engine-level admission checks shared by the kernel path and the
+    symbolic lane: None when the state may enter a batch at all, else
+    the fallback-reason bucket ("dynamic" for shape/gas refusals,
+    "symbolic" when the memory window cannot densify)."""
     mstate = global_state.mstate
     stack = mstate.stack
     if len(stack) < run.touch:
-        return False  # underflow: per-state path raises the exact error
+        return "dynamic"  # underflow: per-state path raises the error
     if len(stack) - run.touch + run.capacity > STACK_LIMIT:
-        return False  # could overflow mid-run
+        return "dynamic"  # could overflow mid-run
     if (mstate.gas_limit > GAS_ENCODE_CAP
             or mstate.min_gas_used > GAS_ENCODE_CAP
             or mstate.max_gas_used > GAS_ENCODE_CAP
             or mstate.memory.size > GAS_ENCODE_CAP):
-        return False
-    # only window slots some compute op CONSUMES must be concrete and
-    # taint-free; purely-shuffled slots pass through as opaque host
-    # values (decode reuses the original BitVec objects)
+        return "dynamic"
+    if run.has_mload and mstate.memory.dense_window(run.window) is None:
+        return "symbolic"
+    return None
+
+
+def consumed_windows_concrete(global_state, run: Run) -> bool:
+    """Only window slots some compute op CONSUMES must be concrete and
+    taint-free; purely-shuffled slots pass through as opaque host
+    values (decode reuses the original BitVec objects)."""
+    stack = global_state.mstate.stack
     base = len(stack) - run.touch
     for j in run.consumed_windows:
         if encodable_word(stack[base + j]) is None:
             return False
-    if run.has_mload and mstate.memory.dense_window(run.window) is None:
-        return False
     return True
+
+
+def state_encodable(global_state, run: Run) -> bool:
+    """Per-state KERNEL-path batch admission for `run` (the stepper has
+    already checked engine-level and code-level conditions). The
+    symbolic lane (symlane.admit) relaxes the consumed-window
+    concreteness requirement per row; this predicate is the lane-off
+    behavior and the "kernel" verdict's definition. (The stepper's
+    _admit composes the two halves itself so the prechecks — and the
+    dense-window build they imply — run once per sibling.)"""
+    if state_prechecks(global_state, run) is not None:
+        return False
+    return consumed_windows_concrete(global_state, run)
 
 
 class DenseFrontier:
     __slots__ = ("stack", "depth", "mem", "mem_written", "msize", "pc",
-                 "min_gas", "max_gas", "gas_limit", "live")
+                 "min_gas", "max_gas", "gas_limit", "live", "sym_tags",
+                 "handles")
 
     def __init__(self, n: int, touch: int, window: int):
         self.stack = np.zeros((n, touch, words.LIMBS), dtype=np.int32)
@@ -91,6 +112,15 @@ class DenseFrontier:
         self.max_gas = np.zeros(n, dtype=np.int32)
         self.gas_limit = np.zeros(n, dtype=np.int32)
         self.live = np.zeros(n, dtype=bool)
+        # the symbolic-value lane (populated only under encode's `lane`
+        # mode): per-slot tag (True = the limbs are a placeholder and
+        # the slot's value is an opaque term handle) + the per-row
+        # handle table — the row's window entries as the ORIGINAL
+        # BitVec objects, snapshotted at encode time. The kernel never
+        # reads them; the lane's structural replay initializes its
+        # shadow stack from exactly this table.
+        self.sym_tags = None
+        self.handles = None
 
     @property
     def batch(self) -> int:
@@ -98,21 +128,37 @@ class DenseFrontier:
 
 
 def encode_frontier(states: List, run: Run,
-                    pad_to: Optional[int] = None) -> DenseFrontier:
-    """Densify `states` (all pre-checked with state_encodable) for `run`,
-    padding the batch axis to `pad_to` slots (jit shape bucketing) with
-    dead copies of state 0's row shapes."""
+                    pad_to: Optional[int] = None,
+                    lane: bool = False) -> DenseFrontier:
+    """Densify `states` (all pre-checked with state_encodable or the
+    symbolic lane's admit) for `run`, padding the batch axis to
+    `pad_to` slots (jit shape bucketing) with dead copies of state 0's
+    row shapes. With `lane`, each row additionally carries the
+    symbolic-value lane's tag vector and handle table (the window's
+    ORIGINAL BitVec objects) — what the structural replay decodes
+    opaque rows from."""
     n = len(states)
     slots = max(pad_to or n, n)
     dense = DenseFrontier(slots, run.touch, run.window)
+    if lane:
+        dense.sym_tags = np.zeros((slots, run.touch), dtype=bool)
+        dense.handles = [None] * slots
     for i, global_state in enumerate(states):
         mstate = global_state.mstate
         stack = mstate.stack
         base = len(stack) - run.touch
+        if lane:
+            dense.handles[i] = list(stack[base:]) if run.touch else []
         for j in range(run.touch):
             value = encodable_word(stack[base + j])
             if value is None:
-                continue  # passthrough-only slot: limbs are never read
+                # opaque lane: the limbs stay a placeholder; the tag
+                # plus the per-row handle table carry the slot's real
+                # value (the original BitVec object) host-side for the
+                # passthrough/structural-replay decode
+                if lane:
+                    dense.sym_tags[i, j] = True
+                continue
             dense.stack[i, j] = np.frombuffer(
                 value.to_bytes(32, "big"), dtype=np.uint8)
         dense.depth[i] = len(stack)
@@ -159,6 +205,24 @@ def fork_operands(global_state, run: Run, fork_out, i: int):
 
     return (operand(run.fork.dest_source, fork_out[0]),
             operand(run.fork.cond_source, fork_out[1]))
+
+
+def halt_operands(global_state, run: Run, term_out, i: int):
+    """Row `i`'s popped (offset, length) BitVecs for a RETURN-halting
+    run, with fork_operands' exact source discipline (original window
+    object, or the kernel word interned). Both are dynamically concrete
+    for admitted rows — the lane's tag sim bails opaque operands to the
+    per-state interpreter, whose handler concretizes via the solver."""
+    stack = global_state.mstate.stack
+    base = len(stack) - run.touch
+
+    def operand(source, word):
+        if source >= 0:
+            return stack[base + source]
+        return symbol_factory.BitVecVal(words.int_from_limbs(word[i]), 256)
+
+    return (operand(run.halt.offset_source, term_out[0]),
+            operand(run.halt.length_source, term_out[1]))
 
 
 class PendingFork:

@@ -10,18 +10,30 @@ before any opcode with non-transparent engine hooks (detection modules,
 pruners — those must see every state individually), and before a PUSH
 with a symbolic (deploy-time-patched) operand.
 
-Promoted INTO the fast set this round (per the interp_opcode_wall_top
-histogram): DIV/MOD/SDIV/SMOD as bit-serial restoring division in
-words.py, and the block-terminating symbolic JUMPI as a batched FORK —
-a run may now end in a terminal `jumpi` micro-op that pops the
-destination and condition and hands both words to the host, where the
-stepper's fork epilogue splits every live row into taken/fall-through
-cohorts with per-row pending path-condition literals
-(dense.PendingFork). Deliberately still OUTSIDE the fast set, with the
-per-state interpreter as the oracle: ADDMOD/MULMOD/EXP, SHA3/keccak
-(function-manager constraints), every environment/storage read (values
-are usually symbolic, and SLOAD/SSTORE carry detector and pruner hooks
-in every shipped configuration), and the CALL/CREATE family.
+Promoted INTO the fast set in earlier rounds (per the
+interp_opcode_wall_top histogram): DIV/MOD/SDIV/SMOD as bit-serial
+restoring division in words.py, and the block-terminating symbolic
+JUMPI as a batched FORK — a run may end in a terminal `jumpi` micro-op
+that pops the destination and condition and hands both words to the
+host, where the stepper's fork epilogue splits every live row into
+taken/fall-through cohorts with per-row pending path-condition literals
+(dense.PendingFork).
+
+Promoted this round, on top of the symbolic-value lane
+(laser/frontier/symlane.py, `allow_symbolic`): CALLDATALOAD — with a
+dynamically-concrete offset it promotes to the canonical calldata term
+handle in-batch (the micro-op pops the offset in the kernel and the
+lane's structural replay builds `calldata.get_word_at(offset)` at
+decode, the exact term the interpreter's handler appends) — and
+RETURN/STOP as terminal `halt` micro-ops (`allow_halt`): the run ends
+at the halting instruction and the stepper's halt epilogue rebuilds the
+exact pre-halt state per row, fires the opcode's pre hooks host-side,
+and drives the interpreter's own transaction-end machinery with
+return-data built from the post-decode memory. Deliberately still
+OUTSIDE the fast set, with the per-state interpreter as the oracle:
+ADDMOD/MULMOD/EXP, SHA3/keccak (function-manager constraints), every
+storage read (SLOAD/SSTORE carry detector and pruner hooks in every
+shipped configuration), and the CALL/CREATE family.
 
 Conditionally transparent hooks: an engine hook carrying a
 `frontier_transparent_unless` value predicate (user_assertions' MSTORE
@@ -104,6 +116,27 @@ class ForkInfo:
         self.cond_source = cond_source
 
 
+class HaltInfo:
+    """Static description of a run's terminal RETURN/STOP micro-op.
+
+    `kind` is "return" or "stop"; for RETURN, `offset_source` /
+    `length_source` use ForkInfo's encoding (original window index the
+    popped operand passes through from, or -1 for a kernel-computed
+    word surfaced in term_out). The operands must be dynamically
+    concrete per row — a row popping an opaque offset/length bails to
+    the per-state interpreter, whose handler concretizes via the
+    solver exactly as before."""
+
+    __slots__ = ("pc", "kind", "offset_source", "length_source")
+
+    def __init__(self, pc: int, kind: str,
+                 offset_source: int = -1, length_source: int = -1):
+        self.pc = pc                  # the halting instruction's address
+        self.kind = kind              # "return" | "stop"
+        self.offset_source = offset_source
+        self.length_source = length_source
+
+
 class Run:
     """A compiled straight-line run shared by every sibling state at its
     start pc within one code object."""
@@ -112,14 +145,19 @@ class Run:
                  "capacity", "max_height", "has_mem", "has_mload",
                  "window", "first_instr", "key", "op_names", "op_pcs",
                  "consumed_windows", "out_sources", "fork", "mem_guards",
-                 "cut_at_jumpi")
+                 "cut_at_jumpi", "halt", "has_calldataload",
+                 "cut_at_halt", "cut_at_calldataload")
 
     def __init__(self, ops: List[MicroOp], start_pc: int, end_pc: int,
                  touch: int, out_len: int, max_height: int,
                  has_mem: bool, has_mload: bool, first_instr, key,
                  op_pcs=(), consumed_windows=None, out_sources=None,
                  fork: Optional[ForkInfo] = None, mem_guards=(),
-                 cut_at_jumpi: bool = False):
+                 cut_at_jumpi: bool = False,
+                 halt: Optional[HaltInfo] = None,
+                 has_calldataload: bool = False,
+                 cut_at_halt: bool = False,
+                 cut_at_calldataload: bool = False):
         self.ops = ops
         self.start_pc = start_pc
         self.end_pc = end_pc
@@ -163,6 +201,20 @@ class Run:
         # off / no fork prefix): completed rows exit the batch dialect
         # to the interpreter's fork handler and count as fallback exits
         self.cut_at_jumpi = cut_at_jumpi
+        # terminal RETURN/STOP (None for non-halting runs); mutually
+        # exclusive with `fork`
+        self.halt = halt
+        # the run contains a promoted CALLDATALOAD: every row's decode
+        # takes the symbolic lane's structural replay (the pushed word
+        # is a term handle by construction)
+        self.has_calldataload = has_calldataload
+        # the run stops right before a RETURN/STOP (halt promotion off)
+        # or a CALLDATALOAD (symbolic lane off): completed rows exit
+        # the batch dialect and count as fallback exits — dialect and
+        # symbolic-operand reasons respectively, the symlane on/off
+        # comparator
+        self.cut_at_halt = cut_at_halt
+        self.cut_at_calldataload = cut_at_calldataload
 
     def __len__(self):
         return len(self.ops)
@@ -259,6 +311,13 @@ class _Provenance:
                 self.virtual.append(None)
         elif kind == "pop":
             self._pop()
+        elif kind == "calldataload":
+            # the popped offset rides opaquely (its concreteness is
+            # judged per ROW by the symbolic lane's tag sim, not at
+            # compile time); the pushed word is a term handle the
+            # kernel never computes
+            self._pop()
+            self.virtual.append(None)
         elif kind == "dup":
             self._ensure(op.arg)
             self.virtual.append(self.virtual[-op.arg])
@@ -284,7 +343,9 @@ def extract_run(summary, pc: int,
                 interior_blocked: Callable[[str], bool],
                 first_post_blocked: Callable[[str], bool],
                 guards_for: Optional[Callable] = None,
-                allow_fork: bool = False) -> Optional[Run]:
+                allow_fork: bool = False,
+                allow_halt: bool = False,
+                allow_symbolic: bool = False) -> Optional[Run]:
     """Compile the straight-line run starting at `pc` inside its PR-3
     basic block, or None when no batchable run (>= MIN_RUN_OPS) starts
     there. `interior_blocked(name)` must be True for opcodes carrying any
@@ -296,7 +357,11 @@ def extract_run(summary, pc: int,
     the op then enters the run guarded instead of cutting it. With
     `allow_fork`, a run may terminate in the block's JUMPI as a batched
     fork (its own pre/post hooks fire host-side in the fork epilogue,
-    exactly as the interpreter fires them)."""
+    exactly as the interpreter fires them); with `allow_halt`, in the
+    block's RETURN/STOP as a terminal halt micro-op (same host-side
+    hook discipline, in the stepper's halt epilogue). `allow_symbolic`
+    (the symbolic-value lane) additionally promotes CALLDATALOAD into
+    runs — its hooks gate it exactly like any other interior op."""
     block = summary.cfg.block_at(pc)
     if block is None:
         return None
@@ -312,9 +377,11 @@ def extract_run(summary, pc: int,
     op_pcs: List[int] = []
     prov = _Provenance()
     has_mem = has_mload = False
+    has_calldataload = False
     mem_log_count = 0
     mem_guards = []
     fork: Optional[ForkInfo] = None
+    halt: Optional[HaltInfo] = None
     cut_name = None
     end_pc = pc
     for i in range(start_idx, len(block.instrs)):
@@ -337,7 +404,30 @@ def extract_run(summary, pc: int,
             # stash raw provenance items; converted after the loop
             fork_items = (dest_item, cond_item)
             break
-        if not is_fast_op(name):
+        if allow_halt and name in ("RETURN", "STOP"):
+            # terminal halt: RETURN pops offset then length (tracked,
+            # NOT consumed — the stepper's halt epilogue needs the
+            # exact popped objects, and an opaque operand bails the
+            # row per the lane's tag sim); STOP pops nothing. The
+            # halting instruction's pre hooks fire host-side in the
+            # epilogue on the reconstructed pre-halt state, and its
+            # transaction-end path runs the interpreter's own
+            # machinery — so no hook gating is needed here.
+            spec = BY_NAME[name]
+            kind = name.lower()
+            halt_items = (None, None)
+            if kind == "return":
+                offset_item = prov._pop()
+                length_item = prov._pop()
+                halt_items = (offset_item, length_item)
+            ops.append(MicroOp(kind, None, spec.gas_min, spec.gas_max,
+                               name))
+            op_pcs.append(ins.address)
+            end_pc = ins.address + _instr_width(ins)
+            halt = HaltInfo(ins.address, kind)
+            break
+        lane_op = (name == "CALLDATALOAD" and allow_symbolic)
+        if not is_fast_op(name) and not lane_op:
             break
         guards = None
         if i == start_idx:
@@ -349,7 +439,12 @@ def extract_run(summary, pc: int,
                 # only value-writing stores are guardable: the predicate
                 # needs a dynamically-known written word to judge
                 break
-        op = _compile_one(ins)
+        if lane_op:
+            spec = BY_NAME["CALLDATALOAD"]
+            op = MicroOp("calldataload", None, spec.gas_min,
+                         spec.gas_max, name)
+        else:
+            op = _compile_one(ins)
         if op is None:
             break
         prov.apply(op)
@@ -360,20 +455,41 @@ def extract_run(summary, pc: int,
                 mem_guards.append((mem_log_count, tuple(guards)))
             mem_log_count += 1
             has_mem = True
+        elif op.kind == "calldataload":
+            has_calldataload = True
         ops.append(op)
         op_pcs.append(ins.address)
         end_pc = ins.address + _instr_width(ins)
         cut_name = None
-    min_ops = 2 if fork is not None else MIN_RUN_OPS
+    # fork runs need one prefix op (the fork is the win even on short
+    # runs); halt runs may be BARE — a cohort landing directly on a
+    # STOP/RETURN settles through the halt epilogue with no kernel
+    # work, which is exactly what removes the per-state STOP wall on
+    # dispatch fall-throughs; calldataload-bearing runs are worth a
+    # batch at 2 ops (the [PUSH offset, CALLDATALOAD] ladder shape)
+    if fork is not None:
+        min_ops = 2
+    elif halt is not None:
+        min_ops = 1
+    elif has_calldataload:
+        min_ops = 2
+    else:
+        min_ops = MIN_RUN_OPS
     if len(ops) < min_ops:
         return None
     touch = prov.below
+
+    def _source(item):
+        return -1 if item is None else touch - item[1]
+
     if fork is not None:
         dest_item, cond_item = fork_items
-        fork.dest_source = (-1 if dest_item is None
-                            else touch - dest_item[1])
-        fork.cond_source = (-1 if cond_item is None
-                            else touch - cond_item[1])
+        fork.dest_source = _source(dest_item)
+        fork.cond_source = _source(cond_item)
+    if halt is not None and halt.kind == "return":
+        offset_item, length_item = halt_items
+        halt.offset_source = _source(offset_item)
+        halt.length_source = _source(length_item)
     return Run(
         ops, pc, end_pc,
         touch=touch, out_len=len(prov.virtual),
@@ -386,6 +502,9 @@ def extract_run(summary, pc: int,
                      for item in prov.virtual],
         fork=fork, mem_guards=mem_guards,
         cut_at_jumpi=(fork is None and cut_name == "JUMPI"),
+        halt=halt, has_calldataload=has_calldataload,
+        cut_at_halt=(halt is None and cut_name in ("RETURN", "STOP")),
+        cut_at_calldataload=(cut_name == "CALLDATALOAD"),
         # process-unique token: the kernel's jit cache keys compiled
         # programs by it (object ids would be unsafe — the allocator
         # recycles them, and a stale hit would run the WRONG program)

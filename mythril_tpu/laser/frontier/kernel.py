@@ -178,7 +178,8 @@ def _exec(bk, run: Run, slots, mem, written, msize, min_gas, max_gas,
     # symbolic-index read over the chain sees the same term structure)
     mem_log = []
     # [dest-word, condition-word] when the run terminates in a batched
-    # JUMPI fork (fastset Run.fork), else empty
+    # JUMPI fork (fastset Run.fork), [offset-word, length-word] when it
+    # terminates in a RETURN halt (Run.halt), else empty
     fork_out = []
     xp = bk.xp
     for op in run.ops:
@@ -250,6 +251,25 @@ def _exec(bk, run: Run, slots, mem, written, msize, min_gas, max_gas,
             # ORIGINAL BitVec object instead (fastset provenance).
             fork_out.append(slots.pop())
             fork_out.append(slots.pop())
+        elif kind == "return":
+            # terminal halt op: pop offset then length (the
+            # interpreter's pop order) and surface both words — the
+            # stepper's halt epilogue needs per-row concrete operands
+            # for kernel-computed sources (opaque operands bail the
+            # row before decode per the symbolic lane's tag sim)
+            fork_out.append(slots.pop())
+            fork_out.append(slots.pop())
+        elif kind == "stop":
+            pass  # terminal halt op: no operands, host-side epilogue
+        elif kind == "calldataload":
+            # symbolic-lane op: pop the offset and push a placeholder
+            # word. The pushed value is a TERM HANDLE by construction —
+            # every row of a calldataload-bearing run decodes through
+            # the lane's structural replay, which builds the canonical
+            # calldata.get_word_at term host-side; these limbs are
+            # never read back.
+            slots.pop()
+            slots.append(bk.const_word(words.word_from_int(0)))
         elif kind == "msize":
             slots.append(words.small_to_word(xp, msize))
         elif kind == "pc":
@@ -335,7 +355,9 @@ def _step_jax(run: Run, dense: DenseFrontier):
                dense.min_gas, dense.max_gas, dense.gas_limit, dense.live)
     out = [np.asarray(part) for part in out]
     flat = out[7:]
-    fork_words = 2 if run.fork is not None else 0
+    fork_words = 2 if (run.fork is not None
+                       or (run.halt is not None
+                           and run.halt.kind == "return")) else 0
     flat_log = flat[: len(flat) - fork_words]
     mem_log = [(flat_log[i], flat_log[i + 1])
                for i in range(0, len(flat_log), 2)]

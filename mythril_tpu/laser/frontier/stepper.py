@@ -37,7 +37,7 @@ import random
 import time
 from typing import List, Optional
 
-from mythril_tpu.laser.frontier import dense, fastset, kernel
+from mythril_tpu.laser.frontier import dense, fastset, kernel, symlane
 from mythril_tpu.laser.plugin.signals import PluginSkipState
 from mythril_tpu.observe.tracer import NULL_SPAN, span as trace_span
 
@@ -55,6 +55,14 @@ _MISS = object()
 # counts the handoff as a fallback exit so the branch_fusion on/off
 # legs expose exactly the exits device-side branching removes.
 _FORK_SITE = object()
+# likewise for symbolic-lane-capable sites (one fast op, then a
+# RETURN/STOP halt or a CALLDATALOAD): no run compiled here, and the
+# handoff is counted — dialect or symbolic-operand reason — so the
+# symlane on/off legs expose exactly the exits the lane removes.
+# (pc, reason) pairs; the pc disambiguates from _FORK_SITE handling.
+_LANE_SITE_HALT = object()
+_LANE_SITE_SYMBOLIC = object()
+_LANE_SITES = (_LANE_SITE_HALT, _LANE_SITE_SYMBOLIC)
 
 
 class StepResults(list):
@@ -102,8 +110,21 @@ class FrontierStepper:
         self.fork_enabled = frontier.fork_enabled()
         self.fork_depth_cap = frontier.fork_depth_cap()
         self._fork_ok: Optional[bool] = None
-        log.debug("frontier stepper ready (backend=%s, fork=%s)",
-                  self.backend, self.fork_enabled)
+        # symbolic-value lanes (MYTHRIL_TPU_FRONTIER_SYMLANE): opaque
+        # term-handle slots ride compute ops via the structural replay,
+        # CALLDATALOAD promotes in-batch, RETURN/STOP become terminal
+        # micro-ops the halt epilogue settles host-side
+        self.symlane = frontier.symlane_enabled()
+        # cross-fork re-batching (MYTHRIL_TPU_FRONTIER_MULTIPC): fork
+        # cohorts chain through their next dense run without the
+        # one-iteration worklist stall; the width caps how many cohort
+        # groups one top-level step may chain
+        self.multipc_width = frontier.multipc_width()
+        self._chain_depth = 0
+        self._chain_budget = 0
+        log.debug("frontier stepper ready (backend=%s, fork=%s, "
+                  "symlane=%s, multipc=%d)", self.backend,
+                  self.fork_enabled, self.symlane, self.multipc_width)
 
     # -- engine / hook gates -------------------------------------------------
 
@@ -211,23 +232,37 @@ class FrontierStepper:
                     summary, pc, self._interior_blocked,
                     self._first_post_blocked,
                     guards_for=self._interior_guards,
-                    allow_fork=self._fork_allowed())
-        if run is None and self._minimal_fork_site(code, pc):
-            run = _FORK_SITE
+                    allow_fork=self._fork_allowed(),
+                    allow_halt=self.symlane,
+                    allow_symbolic=self.symlane)
+        if run is None:
+            site = self._minimal_site(code, pc)
+            if site is not None:
+                run = site
         self._runs[key] = run
         return run
 
     @staticmethod
-    def _minimal_fork_site(code, pc: int) -> bool:
-        """One fast op, then a JUMPI: the minimal fork run's shape. When
-        no run compiled here the interpreter takes the branch — the
-        exit the fork feature exists to remove."""
+    def _minimal_site(code, pc: int):
+        """One fast op, then a lane-capable terminator: the minimal
+        batched run's shape. When no run compiled here the interpreter
+        takes the op — exactly the exit the fork / symbolic-lane
+        features exist to remove, so the handoff is counted (by
+        reason) for the on/off comparators."""
         index = code.index_of_address(pc)
         if index is None or index + 1 >= len(code.instruction_list):
-            return False
+            return None
         instrs = code.instruction_list
-        return (fastset.is_fast_op(instrs[index].opcode)
-                and instrs[index + 1].opcode == "JUMPI")
+        if not fastset.is_fast_op(instrs[index].opcode):
+            return None
+        follower = instrs[index + 1].opcode
+        if follower == "JUMPI":
+            return _FORK_SITE
+        if follower in ("RETURN", "STOP"):
+            return _LANE_SITE_HALT
+        if follower == "CALLDATALOAD":
+            return _LANE_SITE_SYMBOLIC
+        return None
 
     def _peek_fast(self, code, pc: int) -> bool:
         index = code.index_of_address(pc)
@@ -235,6 +270,8 @@ class FrontierStepper:
             return False
         instrs = code.instruction_list
         fork_ok = self._fork_allowed()
+        lane_ok = self.symlane
+        seen_calldataload = False
         for k in range(fastset.MIN_RUN_OPS):
             if index + k >= len(instrs):
                 return False
@@ -244,8 +281,24 @@ class FrontierStepper:
                 # prefix at all (the batched fork is the win even on
                 # short runs)
                 return k >= 1
+            if lane_ok and name in ("RETURN", "STOP"):
+                # a halt terminal satisfies the peek even BARE (a
+                # cohort landing on a STOP settles through the halt
+                # epilogue with no kernel work)
+                return True
+            if lane_ok and name == "CALLDATALOAD":
+                # promoted op: a calldataload-bearing run is worth a
+                # batch at 2 ops (extraction enforces the floor), so
+                # any fast prefix before it — or any fast op after a
+                # LEADING calldataload — satisfies the peek
+                if k >= 1:
+                    return True
+                seen_calldataload = True
+                continue
             if not fastset.is_fast_op(name):
-                return False
+                # [CALLDATALOAD, fast-op, blocked] still compiles a
+                # 2-op promoted run — only a sub-2-op shape fails
+                return seen_calldataload and k >= 2
         return True
 
     # -- sibling scheduling --------------------------------------------------
@@ -262,7 +315,22 @@ class FrontierStepper:
             strategy = getattr(strategy, "super_strategy", None)
         return None
 
-    def _collect_siblings(self, lead, run) -> List:
+    def _admit(self, state, run):
+        """Per-row batch admission: ("kernel", None) for the exact
+        kernel decode, ("sym", None) for the symbolic lane's structural
+        replay, or (None, fallback-reason bucket). The prechecks run
+        ONCE here (state_encodable would re-run them — and rebuild the
+        dense memory window — per sibling)."""
+        reason = dense.state_prechecks(state, run)
+        if reason is not None:
+            return None, reason
+        if self.symlane:
+            return symlane.admit(state, run)
+        if dense.consumed_windows_concrete(state, run):
+            return "kernel", None
+        return None, "symbolic"
+
+    def _collect_siblings(self, lead, run, plans) -> List:
         svm = self.svm
         # bytecode-hash equality, not object identity: sibling states of
         # one contract share the Disassembly, but separately-loaded equal
@@ -274,18 +342,21 @@ class FrontierStepper:
         kept = []
         taken = 0
         for state in svm.work_list:
+            verdict = None
             if (taken < MAX_BATCH - 1
                     and state.mstate.pc == pc
                     and state.environment.code.bytecode_hash == code_hash
                     and state.mstate.depth < svm.max_depth
                     and self._span_allows(state, pc, run)
-                    and self._fork_admissible(state, run)
-                    and dense.state_encodable(state, run)):
+                    and self._fork_admissible(state, run)):
+                verdict, _reason = self._admit(state, run)
+            if verdict is not None:
                 if vet is not None and not vet(state):
                     # loop bound exceeded: dropped exactly as the
                     # strategy's own filter would have dropped it
                     taken += 1
                     continue
+                plans[id(state)] = verdict
                 batch.append(state)
                 taken += 1
             else:
@@ -342,35 +413,59 @@ class FrontierStepper:
         if run is _FORK_SITE:
             # fork-capable site the configuration leaves per-state: the
             # interpreter takes this branch (one visit, one exit)
-            SolverStatistics().add_fork_site_exit()
+            SolverStatistics().add_fork_site_exit(reason="dialect")
+            return None
+        if run is _LANE_SITE_HALT:
+            # halt-capable site left per-state (symbolic lane off, or
+            # no compilable prefix): the interpreter ends the frame
+            SolverStatistics().add_fork_site_exit(reason="dialect")
+            return None
+        if run is _LANE_SITE_SYMBOLIC:
+            # CALLDATALOAD site left per-state: the symbolic-operand
+            # exit the lane exists to remove
+            SolverStatistics().add_fork_site_exit(reason="symbolic")
             return None
         if not self._span_allows(lead, pc, run):
             return None
-        if (not self._fork_admissible(lead, run)
-                or not dense.state_encodable(lead, run)):
-            if run.fork is not None and len(run.ops) == 2:
-                # the MINIMAL fork run refused a row: no shorter retry
-                # site exists before the JUMPI — a real dialect exit
-                SolverStatistics().add_fork_site_exit()
+        verdict = None
+        if self._fork_admissible(lead, run):
+            verdict, refusal = self._admit(lead, run)
+        else:
+            refusal = "dialect"  # depth-capped fork: operator brake
+        if verdict is None:
+            if (run.fork is not None or run.halt is not None) \
+                    and len(run.ops) == 2:
+                # the MINIMAL fork/halt run refused a row: no shorter
+                # retry site exists before the terminator — a real exit
+                SolverStatistics().add_fork_site_exit(reason=refusal)
             lead._frontier_skip_span = (run.start_pc, run.end_pc)
             return None
+        if self._chain_depth == 0:
+            # top-level entry: arm the cross-fork re-batching budget
+            # (consumed by _rebatch_cohorts, bounding how many cohort
+            # groups may chain under this one strategy yield)
+            self._chain_budget = self.multipc_width
         with trace_span("laser.frontier_step", cat="laser", pc=pc) as sp:
-            return self._step_batch(lead, run, sp)
+            self._chain_depth += 1
+            try:
+                return self._step_batch(lead, run, sp, verdict)
+            finally:
+                self._chain_depth -= 1
 
     @staticmethod
     def _span_allows(state, pc: int, run) -> bool:
         """Skip-span check that does NOT let a longer run's span eat a
-        fork: a state that failed encoding at a block-head run (its
+        terminal: a state that failed encoding at a block-head run (its
         consumed slots held symbolic calldata) gets a span covering the
-        whole block tail, but the SHORT fork run at the terminator —
-        dispatch ladders are exactly [PUSH dest, JUMPI] after a
-        per-state EQ — may still batch. A fork run whose OWN start pc
-        set the span (a genuine fork-batch bail) still defers to the
+        whole block tail, but the SHORT fork/halt run at the terminator
+        — dispatch ladders are exactly [PUSH dest, JUMPI] after a
+        per-state EQ — may still batch. A terminal run whose OWN start
+        pc set the span (a genuine batch bail) still defers to the
         per-state interpreter, so a persistently-bailing row costs one
         batch attempt per pc, never a loop."""
         if not _span_skipped(state, pc):
             return True
-        if run.fork is None:
+        if run.fork is None and run.halt is None:
             return False
         span = state._frontier_skip_span
         return span is not None and span[0] != pc
@@ -384,10 +479,12 @@ class FrontierStepper:
             return True
         return state.mstate.depth < self.fork_depth_cap
 
-    def _step_batch(self, lead, run, sp=NULL_SPAN) -> Optional[List]:
+    def _step_batch(self, lead, run, sp=NULL_SPAN,
+                    lead_verdict: str = "kernel") -> Optional[List]:
         """The batched step itself (traced as laser.frontier_step)."""
         svm = self.svm
-        batch = self._collect_siblings(lead, run)
+        plans = {id(lead): lead_verdict}
+        batch = self._collect_siblings(lead, run, plans)
 
         # host-side per-state prologue: execute_state hooks (all
         # frontier_once_ok), the run-start statespace snapshot, and the
@@ -436,7 +533,12 @@ class FrontierStepper:
             resilience.maybe_inject("frontier.step")
             pad = (kernel.pad_slots(len(survivors))
                    if self.backend == "jax" else len(survivors))
-            frame = dense.encode_frontier(survivors, run, pad_to=pad)
+            # the lane's tag/handle capture costs a window snapshot per
+            # row: build it only when some collected row actually takes
+            # the structural-replay decode
+            lane_rows = any(verdict == "sym" for verdict in plans.values())
+            frame = dense.encode_frontier(survivors, run, pad_to=pad,
+                                          lane=lane_rows)
             (stack_out, mem, written, msize, min_gas, max_gas, ok,
              mem_log, fork_out) = kernel.step_batch(run, frame,
                                                     self.backend)
@@ -452,16 +554,36 @@ class FrontierStepper:
         results = StepResults()
         completed = []
         pending_forks = []  # dense.PendingFork per forked row, in order
-        fallback_exits = 0
+        halt_rows = []      # (state, popped halt operands) per halt row
+        bails_dynamic = bails_hook = bails_symbolic = 0
+        sym_rows = 0
         for i, state in enumerate(survivors):
+            plan = plans.get(id(state), "kernel")
             row_ok = bool(ok[i])
+            bail_reason = "dynamic"
             if row_ok and run.mem_guards and dense.guard_tripped(
                     run, mem_log, i):
                 # a conditionally-transparent hook is NOT inert for this
                 # row's written value (hevm marker): replay per-state so
                 # the hook fires exactly as it always did
                 row_ok = False
+                bail_reason = "hook"
+            rep = None
+            if row_ok and plan == "sym":
+                # symbolic lane: replay the structural op log over the
+                # row's ORIGINAL window objects — the opaque lanes'
+                # terms, bit-identical to the interpreter's handlers.
+                # A replay fault degrades the row to per-state replay,
+                # never to a wrong term.
+                try:
+                    rep = symlane.replay(state, run,
+                                         window=frame.handles[i])
+                except Exception:
+                    log.warning("symbolic-lane replay failed; per-state "
+                                "replay", exc_info=True)
+                    row_ok = False
             fork_operands = None
+            halt_operands = ()
             if row_ok and run.fork is not None:
                 from mythril_tpu.laser.instructions import concrete_or_none
 
@@ -470,56 +592,100 @@ class FrontierStepper:
                 # bails the row pre-decode so the untouched original
                 # replays per-state and raises the exact
                 # InvalidJumpDestination the interpreter raises
-                fork_operands = dense.fork_operands(state, run, fork_out, i)
+                fork_operands = (rep.terminal if rep is not None else
+                                 dense.fork_operands(state, run,
+                                                     fork_out, i))
                 if concrete_or_none(fork_operands[0]) is None:
                     row_ok = False
+                    bail_reason = "symbolic"
+            if row_ok and run.halt is not None \
+                    and run.halt.kind == "return":
+                halt_operands = (rep.terminal if rep is not None else
+                                 dense.halt_operands(state, run,
+                                                     fork_out, i))
             if row_ok:
-                dense.decode_state(state, run, stack_out, mem, written,
-                                   msize, min_gas, max_gas, i,
-                                   mem_log=mem_log)
+                if rep is not None:
+                    symlane.decode_sym_state(state, run, rep, mem_log,
+                                             msize, min_gas, max_gas, i)
+                    sym_rows += 1
+                else:
+                    dense.decode_state(state, run, stack_out, mem,
+                                       written, msize, min_gas, max_gas,
+                                       i, mem_log=mem_log)
                 snapshot = snapshots.get(id(state))
                 if snapshot is not None:
                     snapshot[0].states.append(snapshot[1])
-                if run.fork is None:
-                    completed.append(state)
-                    results.append(state)
-                else:
+                completed.append(state)
+                if run.fork is not None:
                     pf = self._fork_row(state, run, fork_operands)
-                    completed.append(state)
                     if pf is not None:
                         pending_forks.append(pf)
                     # pf None: PluginSkipState from a JUMPI pre hook —
                     # the row completes with no successors, exactly as
                     # execute_state returns [] on a skipped state
+                elif run.halt is not None:
+                    halt_rows.append((state, halt_operands))
+                else:
+                    results.append(state)
             else:
                 # replay the WHOLE run on the per-state interpreter from
                 # the untouched original state; the span flag keeps every
                 # pc of this run off the batch path for it
                 state._frontier_skip_span = (run.start_pc, run.end_pc)
                 self._retract_loop_visit(state, run)
-                fallback_exits += 1
+                if bail_reason == "hook":
+                    bails_hook += 1
+                elif bail_reason == "symbolic":
+                    bails_symbolic += 1
+                else:
+                    bails_dynamic += 1
                 results.append(state)
 
         from mythril_tpu.smt.solver.statistics import SolverStatistics
 
         stats = SolverStatistics()
-        # completed rows of a run that CUT at an unforked JUMPI exit the
-        # batch dialect to the interpreter's fork handler: counted as
-        # dialect exits (on top of being stepped rows) so the
-        # branch_fusion on/off legs expose exactly the exits
-        # device-side branching removes
-        cut_exits = (len(completed)
-                     if run.fork is None and run.cut_at_jumpi else 0)
+        # completed rows of a run that CUT at an unforked JUMPI or an
+        # unpromoted RETURN/STOP exit the batch dialect to the
+        # interpreter (dialect reason); rows cutting at a CALLDATALOAD
+        # the lane was off for are symbolic-operand exits — on top of
+        # being stepped rows, so the branch_fusion / symlane on/off
+        # legs expose exactly the exits each feature removes
+        cut_exits = symbolic_cuts = 0
+        if run.fork is None and run.halt is None:
+            if run.cut_at_jumpi or run.cut_at_halt:
+                cut_exits = len(completed)
+            elif run.cut_at_calldataload:
+                symbolic_cuts = len(completed)
         stats.add_frontier_step(
             states=len(completed), slots=pad,
-            fallback_exits=fallback_exits, cut_exits=cut_exits)
+            fallback_exits=bails_dynamic, cut_exits=cut_exits,
+            hook_exits=bails_hook, symbolic_exits=bails_symbolic,
+            symbolic_cuts=symbolic_cuts, sym_rows=sym_rows)
         sp.set(states=len(completed), slots=pad,
-               fallbacks=fallback_exits + cut_exits, ops=len(run.ops))
+               fallbacks=(bails_dynamic + bails_hook + bails_symbolic
+                          + cut_exits + symbolic_cuts),
+               ops=len(run.ops), sym_rows=sym_rows)
         if completed:
             for hook in svm._hooks["execute_state"]:
                 replay = getattr(hook, "frontier_batch", None)
                 if replay is not None:
                     replay(completed, run)
+        if run.halt is not None:
+            successors = self._halt_epilogue(run, halt_rows)
+            if not completed:
+                # every row bailed: pure replay, the straight-line bail
+                # shape (no RETURN/STOP executed)
+                return results
+            # bailed rows replay per-state and re-enter the worklist
+            # directly — the exec loop's new_states must carry only the
+            # frame successors (manage_cfg gives them RETURN nodes; a
+            # bailed, untouched original must not get one)
+            if results:
+                svm.work_list.extend(results)
+            results = StepResults(successors)
+            results.op_code = ("RETURN" if run.halt.kind == "return"
+                               else "STOP")
+            return results
         if run.fork is not None:
             successors = self._fork_epilogue(run, pending_forks)
             if not completed and not successors:
@@ -534,6 +700,11 @@ class FrontierStepper:
                 svm.work_list.extend(results)
             results = StepResults(successors)
             results.op_code = "JUMPI"
+            if successors and self.multipc_width and self._chain_budget:
+                # cross-fork re-batching: both cohorts stay dense
+                # through their next run instead of re-entering the
+                # worklist for one serialized iteration
+                results = StepResults(self._rebatch_cohorts(successors))
         return results
 
     # -- the batched fork (device-side branching) ---------------------------
@@ -550,40 +721,276 @@ class FrontierStepper:
             self._fork_pre = hooks
         return hooks
 
-    def _fork_row(self, state, run, operands):
-        """Per-row JUMPI prologue, mirroring execute_state at the fork
-        instruction: reconstruct the exact pre-JUMPI machine state
-        (condition and destination back on top of the decoded stack, pc
-        at the JUMPI), record the statespace snapshot, fire the
-        non-transparent pre hooks host-side, then pop into a pending-
-        fork entry. Returns None when a hook skipped the state (no
-        successors, as execute_state returns [])."""
+    def _terminal_prologue(self, state, pc: int, operands, hooks,
+                           run) -> bool:
+        """Mirror of execute_state at a run terminator, shared by the
+        fork and halt rows: reconstruct the exact pre-terminal machine
+        state (`operands` pushed back in the given order, pc at the
+        instruction), record the statespace snapshot, fire the
+        non-transparent pre hooks host-side, pop the operands back.
+        Returns False when a hook skipped the state (no successors, as
+        execute_state returns [])."""
         svm = self.svm
-        dest_obj, cond_obj = operands
         mstate = state.mstate
-        mstate.pc = run.fork.pc
-        mstate.stack.append(cond_obj)
-        mstate.stack.append(dest_obj)
-        skipped = False
+        mstate.pc = pc
+        for entry in operands:
+            mstate.stack.append(entry)
         if svm.requires_statespace and state.node is not None:
             from mythril_tpu.laser.svm import _StateSnapshot
 
             code = state.environment.code
-            index = code.index_of_address(run.fork.pc)
+            index = code.index_of_address(pc)
             instr = (code.instruction_list[index]
                      if index is not None else run.first_instr)
             state.node.states.append(_StateSnapshot(state, instr))
+        skipped = False
         try:
-            for hook in self._fork_pre_hooks():
+            for hook in hooks:
                 hook(state)
         except PluginSkipState:
             skipped = True
-        mstate.stack.pop()
-        mstate.stack.pop()
-        mstate.pc = run.end_pc
-        if skipped:
+        for _ in operands:
+            mstate.stack.pop()
+        return not skipped
+
+    def _fork_row(self, state, run, operands):
+        """Per-row JUMPI prologue: the terminal reconstruction above
+        (condition below destination, as the handler's pops see them),
+        then pop into a pending-fork entry. None when a hook skipped
+        the state."""
+        dest_obj, cond_obj = operands
+        fired = self._terminal_prologue(state, run.fork.pc,
+                                        (cond_obj, dest_obj),
+                                        self._fork_pre_hooks(), run)
+        state.mstate.pc = run.end_pc
+        if not fired:
             return None
         return dense.build_pending_fork(state, dest_obj, cond_obj)
+
+    # -- the batched halt (terminal RETURN/STOP micro-ops) -------------------
+
+    def _halt_epilogue(self, run, halt_rows) -> List:
+        """Mirror of execute_state at the halting instruction for every
+        completed row: reconstruct the exact pre-halt machine state
+        (operands back on the stack, pc at the RETURN/STOP), record the
+        statespace snapshot, fire the non-transparent pre hooks
+        host-side, then drive the interpreter's own transaction-end
+        machinery — return-data built from the POST-decode memory via
+        Memory.get_byte, so symbolic bytes the run stored come out as
+        the exact terms the interpreter's RETURN would read — with
+        execute_state's signal handling (TransactionEndSignal ->
+        _end_transaction, VmException -> frame revert) and its post-hook
+        kept-loop, verbatim. Deliberately NOT timed into the
+        interp_opcode_wall histogram: these rows no longer take the
+        per-state path, which is the point."""
+        if not halt_rows:
+            return []
+        op_name = "RETURN" if run.halt.kind == "return" else "STOP"
+        pre_hooks, post_hooks = self._halt_hook_lists(op_name)
+        # a BARE halt run (no prefix ops) already fired the terminal's
+        # pre hooks and committed its snapshot in the batch prologue —
+        # the halting instruction IS the run's first instruction, and
+        # the prologue saw the exact pre-halt stack; re-firing here
+        # would double every hook and snapshot
+        bare = len(run.ops) == 1
+        successors = []
+        for state, operands in halt_rows:
+            if bare:
+                state.mstate.pc = run.halt.pc
+            else:
+                push = ()
+                if op_name == "RETURN":
+                    offset_obj, length_obj = operands
+                    push = (length_obj, offset_obj)  # offset on top
+                if not self._terminal_prologue(state, run.halt.pc,
+                                               push, pre_hooks, run):
+                    continue  # no successors, as execute_state returns []
+            for successor in self._run_halting_op(state, op_name,
+                                                  operands):
+                try:
+                    for hook in post_hooks:
+                        hook(successor)
+                except PluginSkipState:
+                    continue
+                successors.append(successor)
+        return successors
+
+    def _halt_hook_lists(self, op_name: str):
+        """Cached non-transparent (pre, post) hook lists for a halting
+        opcode — the _fork_pre_hooks discipline; registration precedes
+        sym_exec, so the lists never change within a run."""
+        cached = getattr(self, "_halt_hooks", None)
+        if cached is None:
+            cached = self._halt_hooks = {}
+        lists = cached.get(op_name)
+        if lists is None:
+            svm = self.svm
+            lists = (
+                [hook for hook in self._hook_entries(
+                    (svm.pre_hooks, svm.instr_pre_hook), op_name)
+                 if not getattr(hook, "frontier_transparent", False)],
+                [hook for hook in self._hook_entries(
+                    (svm.post_hooks, svm.instr_post_hook), op_name)
+                 if not getattr(hook, "frontier_transparent", False)],
+            )
+            cached[op_name] = lists
+        return lists
+
+    def _run_halting_op(self, state, op_name: str, operands) -> List:
+        """RETURN/STOP semantics for one reconstructed row, with
+        execute_state's exception arms: the interpreter's own
+        transaction machinery does all the work, so frame reverts,
+        caller resumption, world-state harvesting and potential-issue
+        checks are the per-state path's code, not a copy. Halting ops
+        charge no opcode gas (the signal propagates before
+        instructions.execute's accrual — the terminal micro-op's spec
+        gas is 0 on both bounds), and RETURN's memory-expansion fee is
+        charged here by the same mem_extend call the handler makes."""
+        svm = self.svm
+        from mythril_tpu.laser.evm_exceptions import VmException
+        from mythril_tpu.laser.instructions import concrete_or_none
+        from mythril_tpu.laser.state.return_data import ReturnData
+        from mythril_tpu.laser.transaction.models import (
+            TransactionEndSignal,
+        )
+
+        try:
+            try:
+                transaction = state.current_transaction
+                if op_name == "STOP":
+                    transaction.end(state, return_data=None, revert=False)
+                else:
+                    offset_obj, length_obj = operands
+                    # both dynamically concrete by admission (an opaque
+                    # operand bailed the row to the per-state path,
+                    # where the handler concretizes via the solver)
+                    length_c = min(concrete_or_none(length_obj), 0x10000)
+                    offset_c = concrete_or_none(offset_obj)
+                    if length_c:
+                        state.mstate.mem_extend(offset_c, length_c)
+                    data = [
+                        state.mstate.memory.get_byte(offset_c + k)
+                        for k in range(length_c)
+                    ]
+                    transaction.end(
+                        state, return_data=ReturnData(data, length_c))
+                return []  # unreachable: transaction.end always raises
+            except VmException as error:
+                # exceptional halt: the frame reverts, exactly as the
+                # exec loop's VmException arm handles it
+                transaction, return_snapshot = \
+                    state.transaction_stack[-1]
+                svm._fire_transaction_end_hooks(
+                    state, transaction, return_snapshot, True)
+                return svm.handle_vm_exception(
+                    state, op_name, str(error))[0]
+        except TransactionEndSignal as signal:
+            return svm._end_transaction(state, signal, op_name)
+
+    # -- cross-fork re-batching (multi-pc) -----------------------------------
+
+    def _rebatch_cohorts(self, successors) -> List:
+        """Both forked cohorts stay dense through their NEXT run
+        instead of re-entering the worklist for one serialized
+        iteration: the fork step's successor set is a multi-pc batch
+        keyed on (code-hash, pc-set) — each distinct pc's cohort (the
+        groups the dense frame's per-row pc table already names)
+        chains through its own compiled run right here, bounded by the
+        MYTHRIL_TPU_FRONTIER_MULTIPC budget armed at the top-level
+        step. manage_cfg runs FIRST with the fork's op code, so every
+        successor gets the exact JUMPI conditional-edge node exec
+        would have assigned — the chained results then return to exec
+        with op_code None and are never node-managed twice. Cohort
+        leads pass the same bounded-loops vetting a strategy yield
+        applies; siblings are vetted by _collect_siblings as usual."""
+        svm = self.svm
+        svm.manage_cfg("JUMPI", successors)
+        vet = self._loop_vetter()
+        groups = {}
+        for state in successors:
+            key = (state.environment.code.bytecode_hash,
+                   state.mstate.pc)
+            groups.setdefault(key, []).append(state)
+        out = []
+        for group in groups.values():
+            if self._chain_budget <= 0:
+                out.extend(group)
+                continue
+            probe = self._run_for(group[0].environment.code,
+                                  group[0].mstate.pc)
+            if probe is None or probe is _FORK_SITE \
+                    or probe in _LANE_SITES:
+                # nothing batchable here; site-exit accounting happens
+                # when the strategy yields these states normally
+                out.extend(group)
+                continue
+            pending = list(group)
+            lead = None
+            while pending:
+                candidate = pending.pop(0)
+                if candidate.mstate.depth >= svm.max_depth:
+                    # past the depth bound: hand it back unchained so
+                    # the strategy discards it on yield, exactly as the
+                    # per-state path would — chaining it would execute
+                    # a run the depth filter forbids
+                    out.append(candidate)
+                    continue
+                if vet is None or vet(candidate):
+                    lead = candidate
+                    break
+                # loop bound exceeded: dropped exactly as the
+                # strategy's own filter would have dropped it
+            if lead is None:
+                continue
+            self._chain_budget -= 1
+            mark = len(svm.work_list)
+            svm.work_list.extend(pending)
+            stepped = self.try_step(lead)
+            if stepped is None:
+                # the lead could not batch after all: undo — retract
+                # the vet's trace entry (the strategy will vet again on
+                # yield) and hand the whole cohort back to the caller
+                restored = svm.work_list[mark:]
+                del svm.work_list[mark:]
+                self._retract_chain_vet(lead)
+                out.append(lead)
+                out.extend(restored)
+            else:
+                # a chained step's own terminal results still carry an
+                # op code (an inner fork past the budget, a halt run's
+                # frame successors): run the node management exec would
+                # have run — dropping it here loses the conditional-
+                # edge nodes AND the function-entry naming that rides
+                # them (found as findings attributed to "fallback" on
+                # the dispatch ladder)
+                inner_op = getattr(stepped, "op_code", None)
+                if inner_op is not None:
+                    svm.manage_cfg(inner_op, stepped)
+                # non-collected siblings stay in the worklist (unvetted
+                # — the strategy vets them on yield, as for any
+                # successor set exec extends)
+                out.extend(stepped)
+        return out
+
+    def _retract_chain_vet(self, state) -> None:
+        """A chained cohort lead that failed to batch will be re-vetted
+        when the strategy yields it — pop the trace entry this chain's
+        vet appended so one real visit counts once (the sibling-side
+        twin of _retract_loop_visit)."""
+        instruction = state.instruction
+        if instruction is None or instruction.opcode != "JUMPDEST" \
+                or self._loop_vetter() is None:
+            return
+        from mythril_tpu.laser.strategy.extensions.bounded_loops import (
+            JumpdestCountAnnotation,
+        )
+
+        for annotation in state.annotations:
+            if isinstance(annotation, JumpdestCountAnnotation):
+                if annotation.trace \
+                        and annotation.trace[-1] == state.mstate.pc:
+                    annotation.trace.pop()
+                return
 
     def _prune_decision(self) -> str:
         """The exec loop's fork-pruning policy, verbatim (one random
@@ -679,11 +1086,13 @@ class FrontierStepper:
                         stats.add_fork_pruned(pruned)
             successors = []
             parkable = []  # (pending fork, its materialized sides)
+            cohort_extra = 0  # materialized rows beyond one per slot
             for pf in pending_forks:
                 flags = keep.get(id(pf), (True, True))
                 sides_out = pf.materialize(keep_fall=flags[0],
                                            keep_jump=flags[1])
                 successors.extend(sides_out)
+                cohort_extra += max(0, len(sides_out) - 1)
                 if pf.symbolic:
                     parkable.append(sides_out)
             if decision == "park" and parkable:
@@ -706,7 +1115,8 @@ class FrontierStepper:
                               if id(s) not in parked]
             if symbolic:
                 stats.add_frontier_fork(len(symbolic),
-                                        time.monotonic() - start)
+                                        time.monotonic() - start,
+                                        cohort_rows=cohort_extra)
             sp.set(forked=len(symbolic), successors=len(successors))
         return successors
 

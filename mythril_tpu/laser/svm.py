@@ -321,7 +321,14 @@ class LaserEVM:
                 # FORK returns op_code "JUMPI" so its successors get the
                 # same conditional-edge nodes the per-state handler's
                 # states get (feasibility pruning already happened inside
-                # the stepper's fork epilogue — one coalesced bundle)
+                # the stepper's fork epilogue — one coalesced bundle); a
+                # batched HALT returns "RETURN"/"STOP" so frame
+                # successors get RETURN nodes (the transaction end
+                # already ran through _end_transaction inside the halt
+                # epilogue); and a fork whose cohorts chained through
+                # their next run (cross-fork re-batching) comes back as
+                # op_code None — the stepper ran manage_cfg for the
+                # fork's own successors before chaining
                 batched = (
                     self._frontier.try_step(global_state)
                     if self._frontier is not None else None

@@ -47,7 +47,9 @@ Everything here is total: any unexpected shape degrades to "no change"
 MYTHRIL_TPU_AIG_OPT on top of the preanalysis master switch.
 """
 
+import contextlib
 import os
+import threading
 from collections import OrderedDict
 from typing import Dict, List, Optional
 
@@ -133,6 +135,48 @@ def _get_session(aig: AIG) -> _StrashSession:
             or _session.aig.num_vars > SESSION_VAR_CAP):
         _session = _StrashSession(uid)
     return _session
+
+
+# -- root-forcing-deferred sweep (fork bundles) ------------------------------
+#
+# The sides of one batched-JUMPI fork pair share every base constraint
+# and differ by exactly the fork literal and its negation. The normal
+# sweep FORCES every root — so side A rewrites its shared base cone
+# under "fork literal = TRUE" and side B under "= FALSE", the rebuilt
+# base roots diverge structurally, and the router's shared-cone pair
+# packing (_pack_fork_pair: "root sets differ by exactly {L, L^1}")
+# misses on exactly the traffic it was built for. Inside this scope the
+# sweep DEFERS root forcing entirely: the cone rebuilds through the
+# session strash table with every root kept as a plain root, so both
+# sides land in ONE session AIG with identical base roots (the second
+# side's rebuild is all clean-memo hits) and the diff is the fork
+# literal pair — the CDCL re-derives the forced constants by unit
+# propagation in microseconds, which is why deferring is cheap.
+# Threadlocal because serve batches hop runner threads.
+
+_defer_tls = threading.local()
+
+
+@contextlib.contextmanager
+def deferred_forcing():
+    """Prepare-scope marker for fork-bundle queries: optimize_roots runs
+    with root forcing deferred (see block comment above)."""
+    depth = getattr(_defer_tls, "depth", 0)
+    _defer_tls.depth = depth + 1
+    try:
+        yield
+    finally:
+        _defer_tls.depth = depth
+
+
+def defer_active() -> bool:
+    """Deferred-forcing scope armed AND not disabled by env
+    (MYTHRIL_TPU_FORK_DEFER_SWEEP=0 restores the per-side forced sweep
+    — the bench on/off comparison for the pair-packing hit rate)."""
+    if not getattr(_defer_tls, "depth", 0):
+        return False
+    return os.environ.get("MYTHRIL_TPU_FORK_DEFER_SWEEP", "") \
+        not in ("0", "off", "false")
 
 
 def _cone_gate_count(aig: AIG, roots) -> int:
@@ -231,10 +275,21 @@ def _trivially_unsat_result(nodes_before: int, const_folds: int,
                         trivially_unsat=True)
 
 
-def optimize_roots(aig: AIG, roots: List[int]) -> Optional[AIGOptResult]:
+def optimize_roots(aig: AIG, roots: List[int],
+                   force_roots: bool = True) -> Optional[AIGOptResult]:
     """Rewrite the cone of `roots` (sweep + strash); None when nothing
     applies (constant-only roots, oversize cone, or any unexpected shape
-    — always degrade to "no change", never a wrong cone)."""
+    — always degrade to "no change", never a wrong cone).
+
+    With `force_roots=False` (fork-bundle queries under
+    deferred_forcing) the constant sweep is DEFERRED: no root is
+    propagated as a forced constant — the cone rebuilds through the
+    session strash table with every root kept as a plain root, so the
+    two sides of a fork pair produce identical base roots in one shared
+    session AIG and the router's shared-cone pair packing hits. The
+    rewrite is returned even when structurally unchanged: symmetry
+    between the sides is the point (one side rewritten and the other
+    degraded to the original AIG could never pair)."""
     live_roots = []
     for lit in roots:
         if lit == TRUE_LIT:
@@ -270,9 +325,20 @@ def optimize_roots(aig: AIG, roots: List[int]) -> Optional[AIGOptResult]:
     cone_vars = sorted(in_cone)
     nodes_before = sum(1 for v in cone_vars if gate_lhs[v] >= 0)
 
-    # -- constant sweep, backward half: decompose forced-TRUE AND gates ----
+    if not force_roots:
+        from mythril_tpu.smt.solver import incremental
+
+        if not incremental.enabled():
+            # without the shared session each side would rebuild into a
+            # private throwaway AIG — the sides could never pair. Keep
+            # the ORIGINAL aig/roots (both sides share the source AIG,
+            # so the pair still packs there).
+            return None
+
+    # -- constant sweep, backward half: decompose forced-TRUE AND gates
+    #    (skipped wholesale when root forcing is deferred) -----------------
     forced: Dict[int, bool] = {}
-    queue = list(live_roots)
+    queue = list(live_roots) if force_roots else []
     while queue:
         lit = queue.pop()
         if lit == TRUE_LIT:
@@ -295,18 +361,23 @@ def optimize_roots(aig: AIG, roots: List[int]) -> Optional[AIGOptResult]:
     # -- liveness, backward half: only structure reachable from the gates
     #    that stay asserted (forced-FALSE gates) is ever rebuilt — the
     #    decomposed conjunction skeleton and dead fanout cones are pruned --
-    live_struct = set()
-    for var in reversed(cone_vars):
-        is_gate = gate_lhs[var] >= 0
-        needs_structure = var in live_struct or (
-            is_gate and forced.get(var) is False)
-        if not needs_structure or not is_gate:
-            continue
-        live_struct.add(var)
-        for child_lit in (gate_lhs[var], gate_rhs[var]):
-            child = child_lit >> 1
-            if child != 0 and child not in forced:
-                live_struct.add(child)
+    if force_roots:
+        live_struct = set()
+        for var in reversed(cone_vars):
+            is_gate = gate_lhs[var] >= 0
+            needs_structure = var in live_struct or (
+                is_gate and forced.get(var) is False)
+            if not needs_structure or not is_gate:
+                continue
+            live_struct.add(var)
+            for child_lit in (gate_lhs[var], gate_rhs[var]):
+                child = child_lit >> 1
+                if child != 0 and child not in forced:
+                    live_struct.add(child)
+    else:
+        # no forcing: every root stays asserted structurally, so the
+        # whole cone of influence is live
+        live_struct = set(cone_vars)
 
     # -- rebuild (forward): substitute forced constants at every use site,
     #    re-hash surviving gates through the SESSION strash table — gates
@@ -407,10 +478,25 @@ def optimize_roots(aig: AIG, roots: List[int]) -> Optional[AIGOptResult]:
     if trivially_unsat:
         return _trivially_unsat_result(nodes_before, const_folds,
                                        strash_merges)
+    if not force_roots:
+        # deferred forcing: roots were not decomposed, so they emit by
+        # direct literal mapping — the rebuilt cone's image of each
+        # original root, polarity preserved
+        for lit in live_roots:
+            mapped = new_lit.get(lit >> 1)
+            if mapped is None:
+                return None  # unexpected shape: degrade to "no change"
+            mapped ^= lit & 1
+            if mapped == FALSE_LIT:
+                return _trivially_unsat_result(nodes_before, const_folds,
+                                               strash_merges)
+            if mapped == TRUE_LIT:
+                continue
+            new_roots.append(mapped)
     new_roots = list(dict.fromkeys(new_roots))
     # cone-local count: the session AIG also holds sibling queries' cones
     nodes_after = _cone_gate_count(new_aig, new_roots)
-    unchanged = (
+    unchanged = force_roots and (
         nodes_after >= nodes_before
         and strash_merges == 0
         and rebuild_folds == 0
@@ -435,12 +521,17 @@ def optimize_roots(aig: AIG, roots: List[int]) -> Optional[AIGOptResult]:
 
 def optimize_roots_cached(aig: AIG, roots: List[int]) \
         -> Optional[AIGOptResult]:
-    key = (getattr(aig, "uid", id(aig)), tuple(roots))
+    # fork-bundle queries (deferred_forcing scope) run the root-forcing-
+    # deferred sweep; the flag is part of the cache key — the same root
+    # set prepared outside a fork bundle must never serve (or be served
+    # by) the unforced rewrite
+    force_roots = not defer_active()
+    key = (getattr(aig, "uid", id(aig)), tuple(roots), force_roots)
     hit = _cache.get(key)
     if hit is not None:
         _cache.move_to_end(key)
         return None if hit is _NOT_APPLICABLE else hit
-    result = optimize_roots(aig, roots)
+    result = optimize_roots(aig, roots, force_roots=force_roots)
     _cache[key] = _NOT_APPLICABLE if result is None else result
     cache_max = _cache_max()
     while len(_cache) > cache_max:

@@ -105,14 +105,45 @@ class SolverStatistics:
         "frontier_states_stepped",
         # states handed back to the per-state interpreter at a
         # batch-capable site: mid-run bails (frontier_batch_bails, a
-        # subset) plus rows whose run CUT at an unforked JUMPI and
-        # per-state handoffs at fork-capable sites the configuration
-        # left unbatched — the branch_fusion on/off comparator
+        # subset) plus rows whose run CUT at an unforked JUMPI /
+        # unpromoted RETURN/STOP/CALLDATALOAD and per-state handoffs at
+        # lane-capable sites the configuration left unbatched — the
+        # branch_fusion / symlane on/off comparator. Always the sum of
+        # the per-reason breakdown below.
         "frontier_fallback_exits",
+        # per-reason breakdown of frontier_fallback_exits, so the next
+        # promotion target is named by counter instead of by re-running
+        # the opcode histogram by hand:
+        #   dialect   the batch dialect simply ends here — cut-at-JUMPI
+        #             completions with forking off, cut-at-RETURN/STOP
+        #             completions with the symbolic lane off, and
+        #             per-state handoffs at minimal fork sites the
+        #             configuration left unbatched
+        #   dynamic   mid-run dynamic bails (memory access beyond the
+        #             dense window, gas exhaustion) and encodability
+        #             refusals at minimal sites for non-symbolic causes
+        #   hook      rows bailed so a conditionally-transparent hook
+        #             could fire per-state (tripped value guard, or a
+        #             guarded store about to write a symbolic word the
+        #             predicate cannot judge)
+        #   symbolic  symbolic-operand exits — a consumed slot, memory
+        #             offset, jump destination, or RETURN operand was
+        #             opaque where the configuration (or the kernel)
+        #             requires a dynamically-concrete value, including
+        #             cut-at-CALLDATALOAD completions with the lane off
+        "frontier_fallback_dialect",
+        "frontier_fallback_dynamic",
+        "frontier_fallback_hook",
+        "frontier_fallback_symbolic",
         # mid-run bails only (slot-occupying rows that exited the batch
         # before completing) — the occupancy numerator's second half
         "frontier_batch_bails",
         "frontier_batch_slots",
+        # symbolic-value lane (laser/frontier/symlane.py): rows whose
+        # decode replayed the structural op log into the original BitVec
+        # terms (at least one opaque lane) instead of the kernel's
+        # concrete limbs — the in-batch symbolic traffic the lane admits
+        "frontier_symlane_rows",
         # device-side branching (laser/frontier/stepper.py): batched
         # symbolic-JUMPI forks — fork events (batch steps that forked),
         # the rows that split into taken/fall-through cohorts, sides
@@ -121,8 +152,22 @@ class SolverStatistics:
         # feasibility cones (tpu/router.py fork lane)
         "frontier_forks",
         "frontier_fork_rows",
+        # materialized fork successors beyond one per forked row (the
+        # fall-through clones): a forked slot leaves the step as TWO
+        # live dense rows, so occupancy credits the extra cohort row —
+        # without it a fork-heavy batch under-reports how much live
+        # state its slots actually produced
+        "frontier_fork_cohort_rows",
         "frontier_fork_infeasible_pruned",
         "fork_stream_dispatches",
+        # shared-cone fork-pair packing (tpu/router.py _pack_fork_pair):
+        # pairs the ragged fork lane TRIED to pack as one shared cone
+        # with per-side extra assumption roots, and pairs that actually
+        # packed shared and rode the stream that way — the hit rate the
+        # root-forcing-deferred aig_opt sweep exists to raise (a forced
+        # per-side constant sweep diverges the shared base roots)
+        "fork_pair_pack_attempts",
+        "fork_pair_pack_hits",
         # fault containment (mythril_tpu/resilience/): every degradation
         # a registered fault site took — retries with jittered backoff,
         # per-stage breaker trips and half-open re-probes, quarantined
@@ -533,44 +578,81 @@ class SolverStatistics:
             self.strash_xquery_merges += count
 
     def add_frontier_step(self, states: int, slots: int,
-                          fallback_exits: int,
-                          cut_exits: int = 0) -> None:
+                          fallback_exits: int = 0,
+                          cut_exits: int = 0,
+                          hook_exits: int = 0,
+                          symbolic_exits: int = 0,
+                          symbolic_cuts: int = 0,
+                          sym_rows: int = 0) -> None:
         """One batched frontier step: `states` sibling machine states
         executed a straight-line opcode run as one device step, padded to
-        `slots` batch slots (the jit shape bucket); `fallback_exits` of
-        the batch bailed mid-run back to the per-state interpreter
-        (symbolic operand materialized, memory-window overflow, gas,
-        tripped value guard); `cut_exits` completed rows whose run cut
-        at an unforked JUMPI — they leave the batch dialect for the
-        interpreter's fork handler (counted in fallback_exits but not
-        in the occupancy numerator: unlike bails they also count as
-        stepped rows)."""
+        `slots` batch slots (the jit shape bucket). Mid-run bails back to
+        the per-state interpreter are split by reason: `fallback_exits`
+        dynamic bails (memory-window overflow, gas exhaustion, a
+        dynamically-symbolic operand where the kernel needs a concrete
+        value), `hook_exits` rows bailed so a conditionally-transparent
+        hook fires per-state (tripped value guard), `symbolic_exits`
+        symbolic-operand bails. `cut_exits` / `symbolic_cuts` are
+        completed rows whose run cut at an unforked JUMPI /
+        unpromoted RETURN/STOP (dialect) or at a CALLDATALOAD the
+        symbolic lane was off for (symbolic-operand) — they leave the
+        batch dialect but, unlike bails, also count as stepped rows.
+        `sym_rows` completed rows decoded via the symbolic lane's
+        structural replay (counted inside `states` too)."""
         if self.enabled:
             self.frontier_vmap_steps += 1
             self.frontier_states_stepped += states
             self.frontier_batch_slots += slots
-            self.frontier_batch_bails += fallback_exits
-            self.frontier_fallback_exits += fallback_exits + cut_exits
+            bails = fallback_exits + hook_exits + symbolic_exits
+            self.frontier_batch_bails += bails
+            self.frontier_fallback_exits += bails + cut_exits \
+                + symbolic_cuts
+            self.frontier_fallback_dynamic += fallback_exits
+            self.frontier_fallback_hook += hook_exits
+            self.frontier_fallback_symbolic += symbolic_exits \
+                + symbolic_cuts
+            self.frontier_fallback_dialect += cut_exits
+            self.frontier_symlane_rows += sym_rows
 
-    def add_fork_site_exit(self, count: int = 1) -> None:
+    def add_fork_site_exit(self, count: int = 1,
+                           reason: str = "dialect") -> None:
         """A state handed to the per-state interpreter at a
-        fork-capable JUMPI site the configuration left unbatched
-        (feature off, hook-gated, depth-capped, or unencodable at the
-        minimal fork run) — the off-leg side of the branch_fusion
-        fallback-exit comparison."""
+        lane-capable site the configuration left unbatched (fork or
+        symbolic-lane feature off, hook-gated, depth-capped, or
+        unencodable at the minimal run) — the off-leg side of the
+        branch_fusion / symlane fallback-exit comparison. `reason`
+        names the breakdown bucket (dialect / dynamic / symbolic)."""
         if self.enabled:
             self.frontier_fallback_exits += count
+            counter = f"frontier_fallback_{reason}"
+            setattr(self, counter, getattr(self, counter) + count)
 
-    def add_frontier_fork(self, rows: int, seconds: float = 0.0) -> None:
+    def add_frontier_fork(self, rows: int, seconds: float = 0.0,
+                          cohort_rows: int = 0) -> None:
         """One batched fork event: `rows` live sibling rows reached a
         symbolic JUMPI and split batch-wise into taken/fall-through
         cohorts inside the dense representation; `seconds` is the fork
         epilogue wall (pending-condition rebuild + coalesced feasibility
-        + cohort materialization)."""
+        + cohort materialization); `cohort_rows` materialized successors
+        BEYOND one per forked row (the fall-through clones) — credited
+        to the batch-occupancy numerator, since each forked slot left
+        the step as that many extra live dense rows."""
         if self.enabled:
             self.frontier_forks += 1
             self.frontier_fork_rows += rows
             self.frontier_fork_wall += seconds
+            self.frontier_fork_cohort_rows += cohort_rows
+
+    def add_fork_pair_pack(self, hit: bool) -> None:
+        """One fork pair the ragged lane tried to pack as a shared cone
+        (both sides blasted in one AIG, root sets differing by exactly
+        the fork literal and its negation). `hit` = it packed shared and
+        both sides rode one stream page set; a miss packs the sides
+        individually — still fork traffic, just no page sharing."""
+        if self.enabled:
+            self.fork_pair_pack_attempts += 1
+            if hit:
+                self.fork_pair_pack_hits += 1
 
     def add_fork_pruned(self, count: int = 1) -> None:
         """Fork sides masked dead after a solver-confirmed (host-CDCL
@@ -702,14 +784,20 @@ class SolverStatistics:
 
     @property
     def frontier_batch_occupancy(self) -> float:
-        """Mean fraction of padded frontier batch slots holding live
-        sibling states (states_stepped + mid-run bails are all live on
-        entry; padding to the jit shape bucket is the waste). Dialect
+        """Mean live dense rows per padded frontier batch slot
+        (states_stepped + mid-run bails are all live on entry; padding
+        to the jit shape bucket is the waste). Fork-cohort rows — the
+        extra fall-through clones a forked slot materializes — count in
+        the numerator too: a fork-heavy batch's slots each produce up
+        to two live rows, and excluding them under-reported occupancy
+        on exactly the batches device-side branching exists for (may
+        exceed 1.0 on fork-dense batches by construction). Dialect
         exits that never occupied a slot (fork-site handoffs) are
         deliberately excluded."""
         if not self.frontier_batch_slots:
             return 0.0
-        return (self.frontier_states_stepped + self.frontier_batch_bails) \
+        return (self.frontier_states_stepped + self.frontier_batch_bails
+                + self.frontier_fork_cohort_rows) \
             / self.frontier_batch_slots
 
     @property
@@ -889,6 +977,7 @@ class SolverStatistics:
         if self.frontier_vmap_steps or self.interp_wall:
             out += (f", frontier: {self.frontier_vmap_steps} vmap steps"
                     f" ({self.frontier_states_stepped} states,"
+                    f" {self.frontier_symlane_rows} symlane rows,"
                     f" {self.frontier_fallback_exits} fallback exits,"
                     f" occupancy {self.frontier_batch_occupancy:.2f}),"
                     f" interp {self.interp_wall:.2f}s wall")
@@ -939,6 +1028,22 @@ class SolverStatistics:
         if device_backend._backend is None:
             return {}
         return device_backend._backend.stats()
+
+
+# the per-reason breakdown of frontier_fallback_exits and the fork
+# pair-packing hit-rate counters, named so tools/check_stats_keys.py can
+# pin them end to end (counter -> stats JSON -> bench ROUTING_KEYS)
+# independently of the aggregate they roll up into
+FALLBACK_REASON_COUNTERS = (
+    "frontier_fallback_dialect",
+    "frontier_fallback_dynamic",
+    "frontier_fallback_hook",
+    "frontier_fallback_symbolic",
+)
+FORK_PAIR_PACK_COUNTERS = (
+    "fork_pair_pack_attempts",
+    "fork_pair_pack_hits",
+)
 
 
 def stat_smt_query(func):

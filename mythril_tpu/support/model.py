@@ -17,7 +17,7 @@ batched TPU solver for eligible queries (with the CPU CDCL as oracle).
 import os
 import time
 from collections import OrderedDict, deque
-from contextlib import contextmanager
+from contextlib import contextmanager, nullcontext
 from typing import Iterable, List, Optional
 
 from mythril_tpu.observe.tracer import span as trace_span
@@ -602,6 +602,23 @@ def _get_models_batch_impl(
     def origin_of(index):
         return origins[index] if index < len(origins) else None
 
+    # fork-pair members prepare under the root-forcing-deferred aig_opt
+    # sweep (preanalysis/aig_opt.deferred_forcing): the per-side forced
+    # constant sweep diverges the pair's shared base roots, which is
+    # exactly what the router's shared-cone pair packing keys on. Gated
+    # on the pair actually being able to reach the ragged fork lane —
+    # elsewhere the forced sweep's smaller CDCL cones win.
+    fork_members = set()
+    if fork_pairs:
+        try:
+            from mythril_tpu.tpu.router import ragged_enabled
+
+            if args.solver_backend == "tpu" and ragged_enabled():
+                for pair in fork_pairs:
+                    fork_members.update(pair)
+        except Exception:
+            fork_members = set()
+
     pending: List[tuple] = []  # (idx, key, fingerprint, solver, prep)
     start = time.monotonic()
     for idx, constraints in enumerate(constraint_sets):
@@ -637,7 +654,13 @@ def _get_models_batch_impl(
         # origins' queries under one baton holder — each must blast into
         # ITS contract's private AIG (id-space isolation is what keeps
         # witness models schedule-independent)
-        with blaster_scope(origin_of(idx)):
+        if idx in fork_members:
+            from mythril_tpu.preanalysis import aig_opt
+
+            prep_scope = aig_opt.deferred_forcing()
+        else:
+            prep_scope = nullcontext()
+        with blaster_scope(origin_of(idx)), prep_scope:
             prep = solver._prepare([])
         if prep.trivial is not None:
             if prep.trivial == SAT:

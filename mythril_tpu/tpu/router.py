@@ -1014,6 +1014,12 @@ class QueryRouter:
             if use_ragged:
                 for qt, qf in fork_pairs:
                     pair = self._pack_fork_pair(qt, qf, problems)
+                    if stats is not None:
+                        # the pair-packing hit rate: shared-cone packs
+                        # vs pairs whose sides had to route individually
+                        # (diverged base roots / different AIGs) — the
+                        # number the root-forcing-deferred sweep raises
+                        stats.add_fork_pair_pack(hit=pair is not None)
                     if pair is None:
                         continue
                     pc, extra_taken, extra_fall = pair

@@ -72,6 +72,17 @@ KNOBS: Tuple[Knob, ...] = (
          (16, 64), "prefix-snapshot memo entries per session"),
     Knob("MYTHRIL_TPU_SNAPSHOT_NODE_CAP", "int", 200_000, "settle",
          (100_000, 400_000), "max lowering-cache nodes worth snapshotting"),
+    # frontier.fork stage: the vmapped frontier's symbolic-value lane
+    # and the fork epilogue's re-batching — both move the fused
+    # step→solve round trip the frontier.fork roofline stage times
+    Knob("MYTHRIL_TPU_FRONTIER_SYMLANE", "int", 1, "frontier.fork",
+         (0,), "symbolic-value lanes in the vmapped frontier (0 = "
+         "concrete lanes only: no CALLDATALOAD promotion, no RETURN/"
+         "STOP terminals, no structural-replay decode)"),
+    Knob("MYTHRIL_TPU_FRONTIER_MULTIPC", "int", 2, "frontier.fork",
+         (0, 4), "cross-fork re-batching width: fork-cohort groups "
+         "chained through their next dense run per fork step (0 = "
+         "every cohort re-enters the worklist)"),
     # serve plane: cross-request batch shape
     Knob("MYTHRIL_TPU_SERVE_BATCH", "int", 4, "serve",
          (2, 8), "requests per interleaved serve batch"),

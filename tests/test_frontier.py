@@ -15,6 +15,18 @@ import random
 
 import pytest
 
+
+@pytest.fixture(autouse=True)
+def _legacy_frontier_dialect(monkeypatch):
+    """Pin the PRE-symlane dialect (concrete lanes only, no RETURN/STOP
+    promotion, no cross-fork re-batching): these tests are the legacy
+    dialect's regression net — the toggles are user-facing, so it must
+    keep working bit for bit. The symbolic lane / halt / multi-pc
+    behaviors have their own differential suite in
+    tests/test_frontier_symlane.py."""
+    monkeypatch.setenv("MYTHRIL_TPU_FRONTIER_SYMLANE", "0")
+    monkeypatch.setenv("MYTHRIL_TPU_FRONTIER_MULTIPC", "0")
+
 from mythril_tpu.disasm import Disassembly
 from mythril_tpu.laser import instructions
 from mythril_tpu.laser.frontier import dense, fastset, kernel
@@ -329,7 +341,12 @@ def test_stepper_batches_siblings_and_counts(monkeypatch):
         assert [e.concrete_value for e in state.mstate.stack] == [36]
     assert stats.frontier_vmap_steps == 1
     assert stats.frontier_states_stepped == 5
-    assert stats.frontier_fallback_exits == 0
+    # with the symbolic lane pinned OFF, completed rows of a run that
+    # cuts at the STOP leave the batch dialect: counted as dialect
+    # exits (the symlane off-leg comparator), not as mid-run bails
+    assert stats.frontier_fallback_exits == 5
+    assert stats.frontier_fallback_dialect == 5
+    assert stats.frontier_batch_bails == 0
     assert stats.frontier_batch_slots == 5
     assert stats.frontier_batch_occupancy == 1.0
 

@@ -29,6 +29,11 @@ def fork_env(monkeypatch):
     monkeypatch.setenv("MYTHRIL_TPU_FRONTIER_BACKEND", "numpy")
     monkeypatch.delenv("MYTHRIL_TPU_FRONTIER_FORK", raising=False)
     monkeypatch.delenv("MYTHRIL_TPU_FRONTIER_FORK_DEPTH", raising=False)
+    # pin the PRE-symlane fork dialect (no halt promotion, no cross-fork
+    # re-batching): these tests are the PR-11 regression net; the new
+    # layers have their own suite in tests/test_frontier_symlane.py
+    monkeypatch.setenv("MYTHRIL_TPU_FRONTIER_SYMLANE", "0")
+    monkeypatch.setenv("MYTHRIL_TPU_FRONTIER_MULTIPC", "0")
     stats = SolverStatistics()
     stats.reset()
     stats.enabled = True

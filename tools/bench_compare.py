@@ -61,6 +61,13 @@ TRAJECTORY_METRICS = (
     # ragged streams their feasibility checks rode
     "branch_fusion.forks",
     "branch_fusion.fork_stream_dispatches",
+    # symbolic-value lane: rows decoded via the structural replay and
+    # the states-stepped delta it buys on the fixed corpus; the
+    # shared-cone pair-packing hit count under the deferred sweep —
+    # any of these going dark is a regression, not noise
+    "branch_fusion.symlane_rows",
+    "branch_fusion.states_stepped",
+    "branch_fusion.pair_pack_hits",
     # cross-contract ragged packing: corpus throughput of the
     # interleaved configuration (up = improvement) and the mixed-origin
     # stream evidence going dark would be a regression
@@ -84,6 +91,9 @@ _HIGHER_BETTER_RE = re.compile(
     # device-side branching going dark on the fixed corpus is a
     # regression, not an informational change
     r"|forks|stream_dispatches"
+    # symbolic lane: replay rows / states stepped / pair-pack hits
+    # falling means the lane (or the deferred sweep) stopped engaging
+    r"|symlane_rows|states_stepped|pair_pack"
     # cross-contract packing: corpus throughput (contracts/hour) and
     # mixed-origin windows both want to go UP
     r"|per_hour|xcontract"
@@ -198,6 +208,11 @@ def extract_metrics(payload: dict) -> Dict[str, object]:
         fusion.get("fork_stream_dispatches_total"))
     put("branch_fusion.findings_equal", fusion.get("findings_equal_all"))
     put("branch_fusion.fallbacks_on", fusion.get("fallback_exits_on"))
+    put("branch_fusion.symlane_rows", fusion.get("symlane_rows_total"))
+    put("branch_fusion.states_stepped", fusion.get("states_stepped_on"))
+    put("branch_fusion.pair_pack_hits", fusion.get("pair_pack_hits_total"))
+    put("branch_fusion.symlane_opcode_wall_s",
+        fusion.get("symlane_opcode_wall_on_s"))
     serve = extra.get("serve") or {}
     put("serve.warm_requests_per_hour",
         serve.get("warm_requests_per_hour"))

@@ -167,6 +167,54 @@ def main(argv) -> int:
                 f"{section_name} \"knobs\" stamp is missing registered "
                 "knobs: " + ", ".join(absent))
 
+    # 8. the frontier fallback-reason breakdown and the fork
+    # pair-packing counters: pinned BY NAME (not just via the generic
+    # _COUNTERS sweep) so renaming or dropping one cannot silently pass
+    # as long as some other counter fills the slot — and the reason
+    # breakdown must actually sum into the aggregate the bench legs
+    # compare (add_frontier_step / add_fork_site_exit keep the
+    # invariant; this proves the counters still exist to keep it)
+    from mythril_tpu.smt.solver.statistics import (
+        FALLBACK_REASON_COUNTERS,
+        FORK_PAIR_PACK_COUNTERS,
+    )
+
+    for name in FALLBACK_REASON_COUNTERS + FORK_PAIR_PACK_COUNTERS:
+        if name not in fields:
+            failures.append(
+                f"pinned frontier counter {name!r} is not a "
+                "SolverStatistics field")
+        if name not in emitted:
+            failures.append(
+                f"pinned frontier counter {name!r} missing from the "
+                "stats JSON emission (as_dict)")
+        if name not in routed:
+            failures.append(
+                f"pinned frontier counter {name!r} missing from "
+                "bench.py ROUTING_KEYS roll-up")
+    # drive the adders on the (otherwise idle) lint-process singleton so
+    # the invariant is actually exercised — a zero-vs-zero comparison
+    # would pass no matter what the adders do
+    probe = SolverStatistics()
+    was_enabled = probe.enabled
+    probe.reset()
+    probe.enabled = True
+    probe.add_frontier_step(states=2, slots=4, fallback_exits=1,
+                            cut_exits=2, hook_exits=3, symbolic_exits=4,
+                            symbolic_cuts=5)
+    probe.add_fork_site_exit(reason="dialect")
+    probe.add_fork_site_exit(count=2, reason="symbolic")
+    reason_sum = sum(getattr(probe, name)
+                     for name in FALLBACK_REASON_COUNTERS)
+    if reason_sum == 0 or reason_sum != probe.frontier_fallback_exits:
+        failures.append(
+            "frontier_fallback_exits does not equal the sum of its "
+            f"per-reason breakdown ({probe.frontier_fallback_exits} != "
+            f"{reason_sum}) — an adder bumped the aggregate without a "
+            "reason bucket (or vice versa)")
+    probe.reset()
+    probe.enabled = was_enabled
+
     registered = {inst.name for inst in metrics.REGISTRY}
     unregistered = sorted(set(fields) - registered)
     if unregistered:
