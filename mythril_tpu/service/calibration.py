@@ -34,10 +34,14 @@ _FILENAME = "calibration.json"
 # stage speed-of-light rates persisted beside per_cell_s (additive keys —
 # same schema version; old entries without them simply report no ceiling
 # for those stages until the next fresh measurement. ragged_bytes_s was
-# added with the ragged paged dispatch: the router re-measures just the
-# stage rates — no kernel round — when a cached entry predates it)
+# added with the ragged paged dispatch, pallas_cells_s with the
+# shape-polymorphic Pallas kernel — its ceiling in block-aligned
+# real-gate cells/s, so roofline/sol_gaps rank the kernel stage against
+# whichever backend MYTHRIL_TPU_KERNEL resolves to: the router
+# re-measures just the stage rates — no XLA kernel round — when a cached
+# entry predates a key)
 STAGE_RATE_KEYS = ("pack_bytes_s", "ship_bytes_s", "ragged_bytes_s",
-                   "settle_clauses_s")
+                   "settle_clauses_s", "pallas_cells_s")
 
 
 def _path() -> str:
@@ -210,7 +214,7 @@ def load_tuned(platform: Optional[str]):
         return None, "stale-schema"
     knobs = entry.get("knobs")
     if not isinstance(knobs, dict) or not knobs or not all(
-            isinstance(name, str) and isinstance(value, (int, float))
+            isinstance(name, str) and isinstance(value, (int, float, str))
             and not isinstance(value, bool)
             for name, value in knobs.items()):
         return None, "malformed"

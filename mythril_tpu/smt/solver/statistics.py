@@ -76,6 +76,18 @@ class SolverStatistics:
         "paged_stream_bytes",
         "cubes_dispatched",
         "cube_device_refutes",
+        # device-kernel backend (tpu/pallas_kernel.py): shape-polymorphic
+        # Pallas round launches, the block-aligned real-gate cells they
+        # stepped (the pallas_cells_s rate unit — a strict subset of the
+        # window rectangle the XLA rounds pay for), and device-kernel
+        # recompiles — every DISTINCT compile signature after the
+        # process's first. The XLA rounds key on the full window
+        # rectangle so fresh shapes keep counting; the Pallas round keys
+        # only on its fixed capacity tuple, which is the zero-recompile
+        # property the bench kernel_backend leg pins.
+        "pallas_launches",
+        "pallas_cells_stepped",
+        "kernel_recompiles",
         # cross-contract ragged packing (service/interleave.py driver +
         # tpu/router.py origin-tagged windows): ragged streams that
         # carried cones from >= 2 DISTINCT contracts in one launch, the
@@ -509,6 +521,23 @@ class SolverStatistics:
             self.cubes_dispatched += cubes
             self.cube_device_refutes += refuted
 
+    def add_pallas_launch(self, cells: int) -> None:
+        """One shape-polymorphic Pallas round launch (interpret mode or
+        pl.pallas_call), stepping `cells` block-aligned real-gate cells
+        (steps x 2 x the stream's padded gate count — the
+        pallas_cells_s calibration unit)."""
+        if self.enabled:
+            self.pallas_launches += 1
+            self.pallas_cells_stepped += cells
+
+    def add_kernel_recompile(self, count: int = 1) -> None:
+        """A device round compiled a DISTINCT kernel signature after the
+        process's first — the per-window-shape compile cost the
+        shape-polymorphic Pallas kernel exists to retire (its signature
+        is the fixed capacity tuple, so it never lands here)."""
+        if self.enabled:
+            self.kernel_recompiles += count
+
     def add_aig_device_components(self, components: int) -> None:
         """Partitioned sub-cones that rode a device dispatch individually
         (the per-component root projection the router performs for
@@ -880,6 +909,12 @@ class SolverStatistics:
         from mythril_tpu.tune import space as tune_space
 
         out["knobs"] = tune_space.resolved_config()
+        # the resolved device-kernel backend (MYTHRIL_TPU_KERNEL): a
+        # string stamp, not a counter — every stats artifact names which
+        # kernel produced its device figures (tpu/pallas_kernel.py)
+        from mythril_tpu.tpu import pallas_kernel
+
+        out["kernel_backend"] = pallas_kernel.kernel_mode()
         # span-summary of the run's trace ({stage: [count, seconds]};
         # empty unless MYTHRIL_TPU_TRACE / --trace enabled the tracer)
         from mythril_tpu.observe.tracer import Tracer
@@ -1043,6 +1078,15 @@ FALLBACK_REASON_COUNTERS = (
 FORK_PAIR_PACK_COUNTERS = (
     "fork_pair_pack_attempts",
     "fork_pair_pack_hits",
+)
+# the Pallas device-kernel counters, pinned BY NAME the same way (the
+# kernel_backend STAMP rides as_dict() as a string key, checked by the
+# same lint): renaming one must fail tools/check_stats_keys.py, not
+# silently drop the kernel_backend bench leg's evidence
+PALLAS_KERNEL_COUNTERS = (
+    "pallas_launches",
+    "pallas_cells_stepped",
+    "kernel_recompiles",
 )
 
 

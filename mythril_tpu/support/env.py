@@ -77,7 +77,7 @@ def resolve_source(name: str, default=None, kind: str = "float"
                    ) -> Tuple[object, str]:
     """(resolved value, source tier) for stamping — same chain the
     readers below use, without caching anything."""
-    cast = _cast_int if kind == "int" else float
+    cast = {"int": _cast_int, "str": str}.get(kind, float)
     return _resolve(name, default, cast)
 
 
@@ -94,3 +94,9 @@ def env_int(name: str, default: int) -> int:
     """Resolved int knob: env > cli > tuned > `default` (lenient cast:
     a tuned profile may round-trip ints through JSON floats)."""
     return _resolve(name, default, _cast_int)[0]
+
+
+def env_str(name: str, default: Optional[str]) -> Optional[str]:
+    """Resolved string knob: env > cli > tuned > `default` (categorical
+    knobs — e.g. MYTHRIL_TPU_KERNEL's backend name)."""
+    return _resolve(name, default, str)[0]

@@ -196,6 +196,18 @@ class DeviceSolverBackend:
         self.ragged_seconds = 0.0
         self.cubes_dispatched = 0
         self.cube_device_refutes = 0
+        # device-kernel backend (tpu/pallas_kernel.py): Pallas round
+        # launches, the block-aligned gate cells they stepped (also
+        # folded into cells_stepped so the roofline kernel stage sees
+        # one stream), and the kernel-shape ledger — every DISTINCT
+        # compile signature after the first is a recompile. The Pallas
+        # signature is the capacity tuple (window shapes are runtime
+        # operands), so it stays at zero where the XLA path's
+        # per-window-shape signatures keep counting.
+        self.pallas_launches = 0
+        self.pallas_cells_stepped = 0
+        self.kernel_recompiles = 0
+        self._kernel_shapes = set()
         self._jax = None
         self._seed = 0
         self._pack_cache = _LRU(512)        # struct key -> PackedCircuit
@@ -286,6 +298,22 @@ class DeviceSolverBackend:
         from mythril_tpu.smt.solver.statistics import SolverStatistics
 
         SolverStatistics().add_cap_reject(count, under_floor=under_floor)
+
+    def _note_kernel_shape(self, signature: tuple) -> None:
+        """Record one device-kernel compile signature. Every DISTINCT
+        signature after the process's first is a recompile the window
+        paid for: the XLA rounds key on the full window rectangle, the
+        Pallas round keys only on the fixed capacity tuple — which is
+        the zero-recompile property the bench kernel_backend leg
+        compares across backends."""
+        if signature in self._kernel_shapes:
+            return
+        if self._kernel_shapes:
+            self.kernel_recompiles += 1
+            from mythril_tpu.smt.solver.statistics import SolverStatistics
+
+            SolverStatistics().add_kernel_recompile()
+        self._kernel_shapes.add(signature)
 
     def pack_problem(self, problem, v1_cap: int):
         """Levelize one (num_vars, clauses, aig_roots) query through the
@@ -478,6 +506,8 @@ class DeviceSolverBackend:
         q = _pow2_slots(dp, len(packed))
 
         shape_key = (n_levels, width, v1, n_roots)
+        self._note_kernel_shape(
+            ("xla_batch", shape_key, q, num_restarts, steps, walk_depth))
 
         def _padded_device(p, skey):
             # ship work AND wall both accrue per MISS (matching pack's
@@ -770,7 +800,22 @@ class DeviceSolverBackend:
         replicas are paid-for work with no buyer). Stream assembly +
         upload accrue into ragged_seconds / paged_stream_bytes (the
         ragged roofline stage); kernel rounds accrue into
-        solve_seconds / cells_stepped like the batch path."""
+        solve_seconds / cells_stepped like the batch path.
+
+        With MYTHRIL_TPU_KERNEL resolving to pallas the stream runs
+        through the shape-polymorphic Pallas round instead
+        (_solve_ragged_stream_pallas); a window that exceeds a kernel
+        capacity falls back HERE to the shape-specialized XLA round."""
+        from mythril_tpu.tpu import pallas_kernel
+
+        if pallas_kernel.kernel_mode() == "pallas":
+            out = self._solve_ragged_stream_pallas(
+                jax, circuit, pallas_kernel, entries, deadline,
+                num_restarts, steps, stop_at_first=stop_at_first)
+            if out is not None:
+                return out
+            log.debug("ragged window exceeds a Pallas kernel capacity; "
+                      "falling back to the XLA round")
         jnp = jax.numpy
         ship_start = time.monotonic()
         stream = circuit.RaggedStream(entries, bucket=shape_bucket)
@@ -781,6 +826,10 @@ class DeviceSolverBackend:
         jax.block_until_ready(list(tensors.values()))
         self.ragged_seconds += time.monotonic() - ship_start
         walk_depth = min(stream.num_levels + 4, circuit.MAX_LEVELS)
+        self._note_kernel_shape(
+            ("xla_ragged", tuple(stream.tensors["out_idx"].shape),
+             stream.v1, tuple(stream.tensors["root_var"].shape),
+             num_restarts, steps, walk_depth))
         self._seed += 1
         key = jax.random.PRNGKey(self._seed)
         key, init_key = jax.random.split(key)
@@ -835,6 +884,100 @@ class DeviceSolverBackend:
         self.solve_seconds += time.monotonic() - solve_start
         return solved, stream.nbytes, completed
 
+    def _solve_ragged_stream_pallas(self, jax, circuit, pallas_kernel,
+                                    entries, deadline, num_restarts: int,
+                                    steps: int,
+                                    stop_at_first: bool = False):
+        """The Pallas lane of _solve_ragged_stream: same round loop and
+        return contract, but the window runs through the ONE compiled
+        shape-polymorphic kernel (tpu/pallas_kernel.py) with the window
+        shape riding runtime operands. The stream is assembled with the
+        IDENTITY bucket — shape buckets exist to amortize XLA compiles,
+        and the Pallas compile key carries no window shape, so bucket
+        padding here would be pure memory waste. Returns None when the
+        window exceeds a kernel capacity (the caller falls back to the
+        XLA round)."""
+        jnp = jax.numpy
+        caps = pallas_kernel.kernel_caps()
+        ship_start = time.monotonic()
+        stream = circuit.RaggedStream(
+            entries, bucket=lambda n: max(int(n), 1))
+        if not stream.ok:
+            self.ragged_seconds += time.monotonic() - ship_start
+            return {}, 0, False
+        flat = pallas_kernel.flatten_stream(stream, caps)
+        if flat is None:
+            self.ragged_seconds += time.monotonic() - ship_start
+            return None
+        flat = pallas_kernel.device_flat(jax, flat)
+        jax.block_until_ready(list(flat.arrays.values()))
+        self.ragged_seconds += time.monotonic() - ship_start
+        lanes = pallas_kernel.pad_lanes(num_restarts, caps)
+        self._note_kernel_shape(("pallas", caps, lanes))
+        walk_depth = min(stream.num_levels + 4, circuit.MAX_LEVELS)
+        interpret = pallas_kernel.interpret_mode()
+        self._seed += 1
+        key = jax.random.PRNGKey(self._seed)
+        key, init_key = jax.random.split(key)
+        x = jax.random.bernoulli(
+            init_key, 0.5, (lanes, caps.var_cap)).astype(jnp.int32)
+        n = stream.num_cones
+        # a launch steps the block-aligned real-gate stream twice per
+        # step (sim + walk) — the Pallas cell unit pallas_cells_s times;
+        # folded into cells_stepped too so the shared roofline stage
+        # tracks whichever kernel is live
+        cells_per_round = steps * 2 * flat.padded_cells
+        from mythril_tpu.smt.solver.statistics import SolverStatistics
+
+        stats = SolverStatistics()
+        solved = {}
+        rounds = stall = 0
+        solve_start = time.monotonic()
+        with trace_span("device.kernel", cat="device", cones=n,
+                        levels=stream.num_levels, width=stream.width,
+                        restarts=lanes,
+                        backend="pallas") as kernel_span:
+            while True:
+                x, found = pallas_kernel.run_round_pallas(
+                    flat, x,
+                    seed=(self._seed * 1000003 + rounds) & 0x7FFFFFFF,
+                    steps=steps, walk_depth=walk_depth, caps=caps,
+                    interpret=interpret)
+                rounds += 1
+                self.pallas_launches += 1
+                self.pallas_cells_stepped += cells_per_round
+                self.cells_stepped += cells_per_round
+                self.flips += n * lanes * steps
+                stats.add_pallas_launch(cells_per_round)
+                found_host = np.asarray(found)  # [lanes, cone_cap]
+                newly = [ci for ci in range(n)
+                         if ci not in solved and found_host[:, ci].any()]
+                if newly:
+                    stall = 0
+                    x_host = np.asarray(x)
+                    for ci in newly:
+                        lane = int(np.argmax(found_host[:, ci]))
+                        solved[ci] = stream.cone_assignment(
+                            ci, x_host[lane])
+                else:
+                    stall += 1
+                if (len(solved) == n or stall >= self.STALL_ROUNDS
+                        or (stop_at_first and solved)):
+                    completed = True
+                    break
+                if time.monotonic() >= deadline:
+                    completed = False
+                    break
+                key, re_key = jax.random.split(key)
+                half = lanes // 2
+                if half:
+                    fresh = jax.random.bernoulli(
+                        re_key, 0.5, (half, caps.var_cap)).astype(jnp.int32)
+                    x = x.at[:half].set(fresh)
+            kernel_span.set(rounds=rounds)
+        self.solve_seconds += time.monotonic() - solve_start
+        return solved, stream.nbytes, completed
+
     @staticmethod
     def bits_from_circuit_assignment(pc, dense, num_vars, assignment):
         """Translate a cone-local circuit assignment into CNF model bits.
@@ -870,6 +1013,13 @@ class DeviceSolverBackend:
                 return False
         return True
 
+    @staticmethod
+    def _kernel_backend() -> str:
+        """The resolved MYTHRIL_TPU_KERNEL backend (the stats stamp)."""
+        from mythril_tpu.tpu import pallas_kernel
+
+        return pallas_kernel.kernel_mode()
+
     def stats(self) -> dict:
         return {
             "queries": self.queries,
@@ -890,6 +1040,10 @@ class DeviceSolverBackend:
             "ragged_seconds": round(self.ragged_seconds, 4),
             "cubes_dispatched": self.cubes_dispatched,
             "cube_device_refutes": self.cube_device_refutes,
+            "pallas_launches": self.pallas_launches,
+            "pallas_cells_stepped": self.pallas_cells_stepped,
+            "kernel_recompiles": self.kernel_recompiles,
+            "kernel_backend": self._kernel_backend(),
             "pack_seconds": round(self.pack_seconds, 4),
             "ship_seconds": round(self.ship_seconds, 4),
             "solve_seconds": round(self.solve_seconds, 4),

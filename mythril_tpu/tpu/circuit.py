@@ -297,11 +297,18 @@ class RaggedStream:
         self.num_levels = bucket(max(num_levels, 1))
         self.max_roots = bucket(max_roots)
         # pow2 cone-slot ramp (cone counts are small; 1.5x buckets under
-        # 64 would all collapse to 64 and waste root-table rows)
+        # 64 would all collapse to 64 and waste root-table rows), STOPPED
+        # at the coalescing window cone cap (scheduler
+        # DEFAULT_COALESCE_MAX_RAGGED): windows only exceed it via cube
+        # replica streams, and doubling past it allocated root-table rows
+        # no window composition could fill (65 cones paid 128 slots).
+        # Beyond the cap the slot count is exact — those oversized
+        # streams are per-cone cube fans, not a recurring window shape
+        # worth bucketing.
         slots = 1
-        while slots < self.num_cones:
+        while slots < self.num_cones and slots < 64:
             slots *= 2
-        self.cone_slots = slots
+        self.cone_slots = max(slots, self.num_cones)
 
         # combined per-level rows: real gates only (out_idx > 0 strips the
         # source circuits' per-level padding), remapped into the page

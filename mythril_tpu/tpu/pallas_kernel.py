@@ -1,0 +1,496 @@
+"""Shape-polymorphic Pallas kernel for the ragged circuit-SLS round.
+
+run_round_ragged (tpu/circuit.py) jits one XLA program PER combined
+window shape: every fresh (levels, width, vars, cones, roots) rectangle
+pays its own compile, which is what forced the mixed-chunk cone cap and
+the compile-ratio chunk heuristic (tpu/router.py). This module replaces
+the XLA round with ONE hand-tiled Pallas kernel over the RaggedStream
+paged tables, shape-polymorphic by construction:
+
+  capacities    every operand pads to fixed, env-tunable capacities
+                (the env summary below); the capacities — never the
+                window shape — are the compile key, so one compiled
+                kernel serves every window. Shape buckets survive only
+                as block-size alignment: the gate stream is processed
+                MYTHRIL_TPU_PALLAS_BLOCK gates per vector op, and a
+                window that exceeds a capacity falls back to the XLA
+                round (counted by the backend).
+  runtime sizes the actual window shape (cones, gates, levels) plus
+                steps / walk depth / RNG seed ride a scalar-prefetch
+                operand, and every kernel loop bounds itself on the
+                operand — work scales with the real window, never the
+                capacity rectangle.
+  gate stream   the [L, W] level tensors flatten to a stream of REAL
+                gates only (the out_idx > 0 mask strips level padding),
+                level-major, with a level_start offset table; simulate
+                walks the stream level by level in BLOCK-wide vector
+                chunks. Chunk lanes past a level's end clamp to the
+                stream's trailing padding slot (out/a/b = var 0, value
+                0 — the padding-gate no-op convention of the XLA path).
+  grid          (restart-lane tile x cone-page tile): x and the found
+                mask block over restart lanes; the paged root tables
+                and walk state block over cone pages. Each instance
+                simulates the combined stream and walks only its cone
+                page's justification frontiers — pages are variable-
+                disjoint, so instances never interfere, and the
+                revisited x output merges per page via the var -> cone
+                ownership table.
+  rng           a counter-based integer hash over (seed, step, lane,
+                cone, root, depth) replaces jax.random inside the
+                kernel — portable across Mosaic and interpret mode and
+                deterministic per seed, like the XLA path's threefry
+                stream. The two paths draw DIFFERENT randomness: parity
+                is at the found-model level (every returned model is
+                gate-consistent and host-verified), never bitwise RNG.
+
+On TPU the kernel lowers through pl.pallas_call; everywhere else it
+runs in Pallas interpret mode, so tier-1 (JAX_PLATFORMS=cpu) exercises
+the real kernel logic on every run.
+
+Env summary (MYTHRIL_TPU_KERNEL is documented in tpu/router.py too):
+  MYTHRIL_TPU_KERNEL            xla | pallas | auto (default auto:
+                                pallas where jax reports a TPU)
+  MYTHRIL_TPU_PALLAS_VAR_CAP    combined-variable (and gate-stream)
+                                capacity of the compiled kernel
+                                (default 65536)
+  MYTHRIL_TPU_PALLAS_CONE_CAP   cone-slot capacity (default 128)
+  MYTHRIL_TPU_PALLAS_ROOT_CAP   per-cone root-table capacity
+                                (default 256)
+  MYTHRIL_TPU_PALLAS_LANE_TILE  restart lanes per grid tile (default 8)
+  MYTHRIL_TPU_PALLAS_CONE_TILE  cone pages per grid tile (default 64)
+  MYTHRIL_TPU_PALLAS_BLOCK      gates per simulate vector chunk
+                                (default 256)
+"""
+
+import functools
+import logging
+import os
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+# mirror of circuit.MAX_LEVELS (not imported: this module must stay
+# importable without jax for the router's mode resolution)
+LEVEL_CAP = 4096
+
+# operand order shared by flatten_stream and the kernel call
+GATE_KEYS = ("g_out", "g_a", "g_an", "g_b", "g_bn")
+VAR_KEYS = ("ga_var", "ga_neg", "gb_var", "gb_neg", "is_gate")
+ROOT_KEYS = ("root_var", "root_neg", "root_mask")
+ARRAY_ORDER = GATE_KEYS + ("level_start",) + VAR_KEYS + ("var_cone",) \
+    + ROOT_KEYS
+
+
+class KernelCaps(NamedTuple):
+    """Fixed capacities of the ONE compiled kernel — the compile key.
+    Window shapes never appear here, which is the whole point."""
+
+    var_cap: int    # combined variable space (gate stream shares it:
+                    # every gate output is a distinct variable)
+    cone_cap: int   # cone slots
+    root_cap: int   # roots per cone page
+    lane_tile: int  # restart lanes per grid tile
+    cone_tile: int  # cone pages per grid tile
+    block: int      # gates per simulate vector chunk
+
+
+def _env_pint(name: str, default: int) -> int:
+    try:
+        value = int(os.environ.get(name, ""))
+    except (TypeError, ValueError):
+        return default
+    return value if value > 0 else default
+
+
+def kernel_caps() -> KernelCaps:
+    """Resolve the kernel capacities from the env (defaults sized so a
+    full evidence-mode coalescing window fits with room to spare)."""
+    cone_tile = _env_pint("MYTHRIL_TPU_PALLAS_CONE_TILE", 64)
+    cone_cap = _env_pint("MYTHRIL_TPU_PALLAS_CONE_CAP", 128)
+    cone_tile = min(cone_tile, cone_cap)
+    if cone_cap % cone_tile:
+        cone_cap = -(-cone_cap // cone_tile) * cone_tile
+    return KernelCaps(
+        var_cap=_env_pint("MYTHRIL_TPU_PALLAS_VAR_CAP", 1 << 16),
+        cone_cap=cone_cap,
+        root_cap=_env_pint("MYTHRIL_TPU_PALLAS_ROOT_CAP", 256),
+        lane_tile=_env_pint("MYTHRIL_TPU_PALLAS_LANE_TILE", 8),
+        cone_tile=cone_tile,
+        block=_env_pint("MYTHRIL_TPU_PALLAS_BLOCK", 256),
+    )
+
+
+# -- backend selection (MYTHRIL_TPU_KERNEL) ------------------------------
+
+_MODE: Optional[str] = None
+
+
+def _platform_is_tpu() -> bool:
+    try:
+        import jax
+
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def kernel_mode() -> str:
+    """Resolved MYTHRIL_TPU_KERNEL backend: "pallas" or "xla".
+
+    "auto" (the default) picks Pallas only where jax reports a real
+    TPU — everywhere else the XLA round stays the default and Pallas
+    runs opt-in through interpret mode (tests, CPU parity legs).
+    Cached per process; reset_kernel_mode() for tests."""
+    global _MODE
+    if _MODE is None:
+        # env_str chain (env > cli > tuned > default) so a tuned-profile
+        # backend choice reaches the dispatcher like any numeric knob
+        from mythril_tpu.support.env import env_str
+
+        raw = (env_str("MYTHRIL_TPU_KERNEL", None) or "auto")
+        raw = raw.strip().lower() or "auto"
+        if raw in ("pallas", "xla"):
+            _MODE = raw
+        else:
+            if raw != "auto":
+                log.warning("unknown MYTHRIL_TPU_KERNEL=%r; using auto",
+                            raw)
+            _MODE = "pallas" if _platform_is_tpu() else "xla"
+    return _MODE
+
+
+def reset_kernel_mode() -> None:
+    """Testing hook: drop the cached resolution (and compiled rounds —
+    capacity env changes must reach the next pallas_call)."""
+    global _MODE
+    _MODE = None
+    _round_fn.cache_clear()
+
+
+def interpret_mode() -> bool:
+    """True everywhere pl.pallas_call cannot lower natively (no TPU):
+    the kernel then runs through the Pallas interpreter, which traces
+    the same kernel logic to regular XLA ops."""
+    return not _platform_is_tpu()
+
+
+# -- host-side flattening -------------------------------------------------
+
+
+class FlatStream(NamedTuple):
+    """One RaggedStream flattened into the kernel's fixed-capacity
+    paged layout (numpy or device arrays in `arrays`, ARRAY_ORDER)."""
+
+    arrays: dict
+    num_cones: int
+    num_gates: int
+    num_levels: int
+    padded_cells: int  # block-aligned gate cells one simulate pass touches
+
+
+def flatten_stream(stream, caps: KernelCaps) -> Optional["FlatStream"]:
+    """Flatten one assembled RaggedStream into the kernel layout.
+
+    Strips the level tensors' padding rows (out_idx > 0), orders the
+    surviving real gates level-major into a flat stream with a
+    level_start offset table, pads every table to the fixed capacities,
+    and builds the var -> cone page-ownership map the merge-write needs.
+    Returns None when the window exceeds a capacity — the caller falls
+    back to the XLA round (and counts the fallback)."""
+    tensors = stream.tensors
+    live = tensors["out_idx"] > 0
+    counts = live.sum(axis=1).astype(np.int64)
+    num_gates = int(counts.sum())
+    num_levels = int(np.nonzero(counts)[0].max() + 1) if num_gates else 0
+    v1 = int(stream.v1)
+    cone_slots, max_roots = tensors["root_var"].shape
+    if (v1 > caps.var_cap or num_gates >= caps.var_cap
+            or cone_slots > caps.cone_cap or max_roots > caps.root_cap
+            or num_levels > LEVEL_CAP):
+        return None
+
+    arrays = {}
+    gate_src = {"g_out": "out_idx", "g_a": "a_var", "g_an": "a_neg",
+                "g_b": "b_var", "g_bn": "b_neg"}
+    for key in GATE_KEYS:
+        # row-major boolean indexing == level-major stream order; the
+        # trailing capacity slots stay zero (the clamp target of chunk
+        # lanes past a level's end — a var-0 no-op gate)
+        flat = np.zeros((caps.var_cap,), dtype=np.int32)
+        flat[:num_gates] = tensors[gate_src[key]][live]
+        arrays[key] = flat
+    level_start = np.full((LEVEL_CAP + 1,), num_gates, dtype=np.int32)
+    level_start[0] = 0
+    if num_levels:
+        level_start[1:num_levels + 1] = np.cumsum(counts[:num_levels])
+    arrays["level_start"] = level_start
+    for key in VAR_KEYS:
+        padded = np.zeros((caps.var_cap,), dtype=np.int32)
+        padded[:v1] = tensors[key]
+        arrays[key] = padded
+    var_cone = np.full((caps.var_cap,), -1, dtype=np.int32)
+    for ci, (base, size) in enumerate(stream.pages):
+        var_cone[base: base + size] = ci
+    arrays["var_cone"] = var_cone
+    for key in ROOT_KEYS:
+        padded = np.zeros((caps.cone_cap, caps.root_cap), dtype=np.int32)
+        padded[:cone_slots, :max_roots] = tensors[key]
+        arrays[key] = padded
+    if num_levels:
+        blocks = -(-counts[:num_levels] // caps.block)
+        padded_cells = int((blocks * caps.block).sum())
+    else:
+        padded_cells = 0
+    return FlatStream(arrays=arrays, num_cones=int(stream.num_cones),
+                      num_gates=num_gates, num_levels=num_levels,
+                      padded_cells=padded_cells)
+
+
+def device_flat(jax, flat: FlatStream) -> FlatStream:
+    """Upload a flattened stream once; rounds then reuse the resident
+    tables (the backend's ship seam)."""
+    jnp = jax.numpy
+    return flat._replace(
+        arrays={k: jnp.asarray(v) for k, v in flat.arrays.items()})
+
+
+def pad_lanes(num_restarts: int, caps: KernelCaps) -> int:
+    """Restart lanes padded up to a whole number of lane tiles (extra
+    lanes are ordinary extra restarts, never masked)."""
+    return -(-num_restarts // caps.lane_tile) * caps.lane_tile
+
+
+# -- the kernel -----------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=8)
+def _round_fn(caps: KernelCaps, lanes: int, interpret: bool):
+    """Build (and cache) the jitted pallas_call round for one capacity
+    signature. The cache key carries NO window shape — that is the
+    zero-recompile property the backend's shape-signature counter
+    verifies against the XLA path."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    g_cap = v_cap = caps.var_cap
+    c_cap, r_cap = caps.cone_cap, caps.root_cap
+    lt_size, ct_size, blk = caps.lane_tile, caps.cone_tile, caps.block
+    grid = (lanes // lt_size, c_cap // ct_size)
+
+    def _hash32(value):
+        # xorshift-multiply finalizer (splitmix-style): the kernel's
+        # counter-based RNG — one uint32 in, one well-mixed uint32 out
+        value = value.astype(jnp.uint32)
+        value = (value ^ (value >> 16)) * jnp.uint32(0x7FEB352D)
+        value = (value ^ (value >> 15)) * jnp.uint32(0x846CA68B)
+        return value ^ (value >> 16)
+
+    def kernel(sizes_ref,
+               g_out_ref, g_a_ref, g_an_ref, g_b_ref, g_bn_ref,
+               level_start_ref,
+               ga_var_ref, ga_neg_ref, gb_var_ref, gb_neg_ref,
+               is_gate_ref, var_cone_ref,
+               root_var_ref, root_neg_ref, root_mask_ref,
+               x_in_ref, x_out_ref, found_ref):
+        num_levels = sizes_ref[2]
+        steps = sizes_ref[3]
+        walk_depth = sizes_ref[4]
+        seed = sizes_ref[5].astype(jnp.uint32)
+        lt = pl.program_id(0)
+        ct = pl.program_id(1)
+        lanes_g = (lt * lt_size
+                   + jnp.arange(lt_size, dtype=jnp.int32))  # global lanes
+        cones_g = (ct * ct_size
+                   + jnp.arange(ct_size, dtype=jnp.int32))  # global slots
+
+        g_out = g_out_ref[...]
+        g_a, g_an = g_a_ref[...], g_an_ref[...]
+        g_b, g_bn = g_b_ref[...], g_bn_ref[...]
+        level_start = level_start_ref[...]
+        ga_var, ga_neg = ga_var_ref[...], ga_neg_ref[...]
+        gb_var, gb_neg = gb_var_ref[...], gb_neg_ref[...]
+        is_gate = is_gate_ref[...]
+        var_cone = var_cone_ref[...]
+        root_var = root_var_ref[...]    # [CT, R_CAP] cone-page tile
+        root_neg = root_neg_ref[...]
+        root_mask = root_mask_ref[...]
+        x0 = x_in_ref[...]
+
+        def simulate(x):
+            """Level-major pass over the real-gate stream, BLOCK gates
+            per vector op. Chunk lanes past the level's end clamp to
+            the zero-padded tail slot (a var-0 no-op write)."""
+            x = x.at[:, 0].set(0)
+
+            def level_body(level, x):
+                seg_start = level_start[level]
+                seg_end = level_start[level + 1]
+                nblk = (seg_end - seg_start + blk - 1) // blk
+
+                def block_body(k, x):
+                    idx = (seg_start + k * blk
+                           + jnp.arange(blk, dtype=jnp.int32))
+                    idx = jnp.where(idx < seg_end, idx, g_cap - 1)
+                    av = (jnp.take(x, jnp.take(g_a, idx), axis=1)
+                          ^ jnp.take(g_an, idx)[None, :])
+                    bv = (jnp.take(x, jnp.take(g_b, idx), axis=1)
+                          ^ jnp.take(g_bn, idx)[None, :])
+                    return x.at[:, jnp.take(g_out, idx)].set(av & bv)
+
+                return lax.fori_loop(0, nblk, block_body, x)
+
+            return lax.fori_loop(0, num_levels, level_body, x)
+
+        def root_violations(x):
+            vals = jnp.take(x, root_var.reshape(-1), axis=1)
+            vals = vals.reshape(lt_size, ct_size, r_cap)
+            vals = vals ^ root_neg[None, :, :]
+            return (vals == 0) & (root_mask[None, :, :] == 1)
+
+        def step_body(step, carry):
+            x, found = carry
+            x = simulate(x)
+            violated = root_violations(x)
+            found = found | (violated.sum(axis=2) == 0)
+            step_key = _hash32(
+                seed ^ (step.astype(jnp.uint32) * jnp.uint32(0x9E3779B9)))
+            # violated-root pick: max hashed key among the violated —
+            # uniform over the violated set, decorrelated per
+            # (lane, cone, step)
+            root_keys = _hash32(
+                step_key
+                ^ (lanes_g.astype(jnp.uint32)[:, None, None]
+                   * jnp.uint32(0x85EBCA6B))
+                ^ (cones_g.astype(jnp.uint32)[None, :, None]
+                   * jnp.uint32(0xC2B2AE35))
+                ^ (jnp.arange(r_cap, dtype=jnp.uint32)[None, None, :]
+                   * jnp.uint32(0x27D4EB2F)))
+            keyed = jnp.where(
+                violated, (root_keys >> 1).astype(jnp.int32), -1)
+            choice = jnp.argmax(keyed, axis=2)  # [LT, CT]
+            start_var = jnp.take_along_axis(
+                jnp.broadcast_to(root_var[None, :, :], keyed.shape),
+                choice[..., None], axis=2)[..., 0]
+            start_neg = jnp.take_along_axis(
+                jnp.broadcast_to(root_neg[None, :, :], keyed.shape),
+                choice[..., None], axis=2)[..., 0]
+            # satisfied cones park their walk on var 0 (is_gate[0]==0
+            # terminates it at entry), exactly like the XLA path
+            start_var = jnp.where(found, 0, start_var)
+
+            def walk_body(depth, wcarry):
+                cur, want, done = wcarry
+                is_g = (jnp.take(is_gate, cur) == 1) & (~done)
+                av_i = jnp.take(ga_var, cur)
+                an = jnp.take(ga_neg, cur)
+                bv_i = jnp.take(gb_var, cur)
+                bn = jnp.take(gb_neg, cur)
+                av = jnp.take_along_axis(x, av_i, axis=1) ^ an
+                bv = jnp.take_along_axis(x, bv_i, axis=1) ^ bn
+                gate_val = av & bv
+                justified = gate_val == want
+                coin_bits = _hash32(
+                    step_key ^ jnp.uint32(0x94D049BB)
+                    ^ (lanes_g.astype(jnp.uint32)[:, None]
+                       * jnp.uint32(0x85EBCA6B))
+                    ^ (cones_g.astype(jnp.uint32)[None, :]
+                       * jnp.uint32(0xC2B2AE35))
+                    ^ (depth.astype(jnp.uint32) * jnp.uint32(0x165667B1)))
+                coin = (coin_bits & 1).astype(jnp.bool_)
+                choose_b1 = (((av == 1) & (bv == 0))
+                             | ((av == 0) & (bv == 0) & coin))
+                choose_b0 = (((av == 0) & (bv == 1))
+                             | ((av == 1) & (bv == 1) & coin))
+                choose_b = jnp.where(want == 1, choose_b1, choose_b0)
+                child_var = jnp.where(choose_b, bv_i, av_i)
+                child_neg = jnp.where(choose_b, bn, an)
+                child_want = want ^ child_neg
+                step_active = is_g & (~justified)
+                cur = jnp.where(step_active, child_var, cur)
+                want = jnp.where(step_active, child_want, want)
+                done = done | (~is_g) | justified
+                return cur, want, done
+
+            want0 = jnp.ones_like(start_var) ^ start_neg
+            done0 = start_var < 0
+            cur, want, _ = lax.fori_loop(
+                0, walk_depth, walk_body, (start_var, want0, done0))
+            cur_val = jnp.take_along_axis(x, cur, axis=1)
+            new_val = jnp.where(found, cur_val, want)
+            x = x.at[jnp.arange(lt_size)[:, None], cur].set(new_val)
+            return x, found
+
+        found0 = jnp.zeros((lt_size, ct_size), dtype=jnp.bool_)
+        x, found = lax.fori_loop(0, steps, step_body, (x0, found0))
+        # final simulate: returned assignments must be gate-consistent
+        x = simulate(x)
+        violated = root_violations(x)
+        found = found | (violated.sum(axis=2) == 0)
+
+        # merge-write: this instance owns only its cone pages' columns
+        # of the revisited x block; the first visit seeds the rest from
+        # the init so unowned (padding) columns stay deterministic
+        own = (var_cone >= ct * ct_size) & (var_cone < (ct + 1) * ct_size)
+        prev = jnp.where(ct == 0, x0, x_out_ref[...])
+        x_out_ref[...] = jnp.where(own[None, :], x, prev)
+        found_ref[...] = found
+
+    def _full(shape):
+        return pl.BlockSpec(shape, lambda lt, ct, sz: (0,) * len(shape))
+
+    in_specs = (
+        [_full((g_cap,)) for _ in GATE_KEYS]
+        + [_full((LEVEL_CAP + 1,))]
+        + [_full((v_cap,)) for _ in VAR_KEYS]
+        + [_full((v_cap,))]  # var_cone
+        + [pl.BlockSpec((ct_size, r_cap), lambda lt, ct, sz: (ct, 0))
+           for _ in ROOT_KEYS]
+        + [pl.BlockSpec((lt_size, v_cap), lambda lt, ct, sz: (lt, 0))]
+    )
+    out_specs = [
+        pl.BlockSpec((lt_size, v_cap), lambda lt, ct, sz: (lt, 0)),
+        pl.BlockSpec((lt_size, ct_size), lambda lt, ct, sz: (lt, ct)),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((lanes, v_cap), jnp.int32),
+        jax.ShapeDtypeStruct((lanes, c_cap), jnp.bool_),
+    ]
+
+    @jax.jit
+    def round_fn(sizes, *operands):
+        return pl.pallas_call(
+            kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=grid,
+                in_specs=in_specs,
+                out_specs=out_specs,
+            ),
+            out_shape=out_shape,
+            interpret=interpret,
+        )(sizes, *operands)
+
+    return round_fn
+
+
+def run_round_pallas(flat: FlatStream, x, seed: int, steps: int,
+                     walk_depth: int, caps: KernelCaps,
+                     interpret: bool):
+    """Advance R restart lanes of one flattened stream by `steps`
+    sim+flip iterations through the Pallas kernel. x is [R, var_cap]
+    int32 with R a multiple of caps.lane_tile (pad_lanes); returns
+    (x, found[R, cone_cap]) — slice found[:, :num_cones].
+
+    steps / walk_depth / seed are RUNTIME operands: changing them (or
+    the window shape) never recompiles."""
+    fn = _round_fn(caps, int(x.shape[0]), bool(interpret))
+    sizes = np.array(
+        [flat.num_cones, flat.num_gates, flat.num_levels,
+         int(steps), int(walk_depth), int(seed) & 0x7FFFFFFF, 0, 0],
+        dtype=np.int32)
+    return fn(sizes, *(flat.arrays[key] for key in ARRAY_ORDER), x)

@@ -115,14 +115,30 @@ Env summary (all optional):
                                 window that chunks consumes one per
                                 stream; default 4; 0 disables ragged
                                 dispatch there)
-  MYTHRIL_TPU_RAGGED_CHUNK_CONES  cones per assembled ragged stream
-                                (0 = auto: derived in evidence mode from
-                                the measured XLA-compile / dispatch-
-                                deadline ratio in the calibration
-                                profile — clamp(deadline / 2*compile_s,
-                                2, 8), floor 2 when unmeasured —
-                                unbounded on a real device; the env
-                                override stays absolute)
+  MYTHRIL_TPU_KERNEL            device-kernel backend: xla (the
+                                shape-specialized jit/vmap rounds),
+                                pallas (the shape-polymorphic Pallas
+                                kernel — pl.pallas_call on TPU,
+                                interpret mode elsewhere), or auto
+                                (default: pallas only where jax reports
+                                a TPU). On the pallas path ragged
+                                admission is memory-budget-only, the
+                                mixed-origin chunk-cone cap retires,
+                                and the cost model charges the measured
+                                pallas_cells_s rate (tpu/pallas_kernel
+                                documents the PALLAS-prefixed capacity
+                                knobs)
+  MYTHRIL_TPU_RAGGED_CHUNK_CONES  cones per assembled ragged stream,
+                                XLA kernel path only (0 = auto: derived
+                                in evidence mode from the measured
+                                XLA-compile / dispatch-deadline ratio
+                                in the calibration profile —
+                                clamp(deadline / 2*compile_s, 2, 8),
+                                floor 2 when unmeasured — unbounded on
+                                a real device; the env override stays
+                                absolute. The shape-polymorphic Pallas
+                                kernel never pays a per-shape compile,
+                                so the cap retires on that path)
   MYTHRIL_TPU_CUBE_VARS         cube-and-conquer split width k (2^k
                                 cubes per hard cone; default 3 on the
                                 CPU platform, 7 on a real device; 0
@@ -603,6 +619,50 @@ class QueryRouter:
         except Exception as error:
             log.info("ragged stage-rate calibration failed (%s); ragged "
                      "roofline ceiling unavailable", error)
+        # Pallas kernel ceiling (tpu/pallas_kernel.py): time the
+        # shape-polymorphic round on the same two-cone calibration
+        # stream — interpret mode off-TPU, pl.pallas_call on a real
+        # device, so the rate reflects whichever lowering is live.
+        # Measured regardless of the ACTIVE MYTHRIL_TPU_KERNEL backend:
+        # the persisted profile must already carry the ceiling when the
+        # operator flips the knob (the stale-key migration re-measures
+        # only once per cache entry). Cell unit: block-aligned REAL
+        # gates x 2 x steps (the pallas_cells_stepped counter's unit).
+        try:
+            jax, _ = self.backend._modules()
+            from mythril_tpu.tpu import circuit, pallas_kernel
+
+            caps = pallas_kernel.kernel_caps()
+            stream = circuit.RaggedStream(
+                [(pc, ()), (pc, ())], bucket=lambda n: max(int(n), 1))
+            flat = (pallas_kernel.flatten_stream(stream, caps)
+                    if stream.ok else None)
+            if flat is not None and flat.padded_cells:
+                flat = pallas_kernel.device_flat(jax, flat)
+                lanes = pallas_kernel.pad_lanes(
+                    self._profile_restarts(), caps)
+                x = jax.random.bernoulli(
+                    jax.random.PRNGKey(2), 0.5,
+                    (lanes, caps.var_cap)).astype(jax.numpy.int32)
+                interp = pallas_kernel.interpret_mode()
+                walk = stream.num_levels + 4
+                # first call pays the one-time capacity-keyed compile;
+                # the second measures the steady state
+                jax.block_until_ready(pallas_kernel.run_round_pallas(
+                    flat, x, seed=1, steps=CAL_STEPS, walk_depth=walk,
+                    caps=caps, interpret=interp))
+                pallas_start = time.monotonic()
+                jax.block_until_ready(pallas_kernel.run_round_pallas(
+                    flat, x, seed=2, steps=CAL_STEPS, walk_depth=walk,
+                    caps=caps, interpret=interp))
+                pallas_elapsed = time.monotonic() - pallas_start
+                pallas_cells = CAL_STEPS * 2 * flat.padded_cells
+                if pallas_elapsed > 0:
+                    rates["pallas_cells_s"] = (
+                        pallas_cells / pallas_elapsed)
+        except Exception as error:
+            log.info("pallas stage-rate calibration failed (%s); pallas "
+                     "kernel ceiling unavailable", error)
         lib = sat_backend._get_native()
         num_clauses = len(prep.clauses)
         if num_clauses:
@@ -638,6 +698,14 @@ class QueryRouter:
         out = dict(self._stage_rates)
         if self._per_cell_s:
             out["kernel_cells_s"] = 1.0 / self._per_cell_s
+        # with the Pallas backend live, the roofline's kernel stage must
+        # rank against the kernel actually running (its cell unit —
+        # block-aligned real gates — is what cells_stepped accrues then)
+        from mythril_tpu.tpu import pallas_kernel
+
+        if (pallas_kernel.kernel_mode() == "pallas"
+                and out.get("pallas_cells_s")):
+            out["kernel_cells_s"] = out["pallas_cells_s"]
         return out
 
     def _profile_steps(self) -> int:
@@ -717,8 +785,21 @@ class QueryRouter:
         bucketed). Same measured per-cell constant and sim+walk 2x as
         est_round_seconds; the difference is the work unit: the
         rectangle the stream actually ships, never a per-query bucket
-        ceiling replicated across the window."""
+        ceiling replicated across the window.
+
+        On the Pallas path the MEASURED Pallas per-cell rate
+        (pallas_cells_s, micro-calibrated) replaces the XLA constant —
+        there is no compile amortization term to charge, and the
+        Pallas round steps only block-aligned real gates, so charging
+        its rate over the same rectangle is a conservative upper
+        bound."""
         per_cell = self._per_cell_s
+        from mythril_tpu.tpu import pallas_kernel
+
+        if pallas_kernel.kernel_mode() == "pallas":
+            pallas_rate = self._stage_rates.get("pallas_cells_s")
+            if pallas_rate:
+                per_cell = 1.0 / pallas_rate
         if per_cell is None:
             per_cell = 1e-7 if self._evidence_mode() else 1e-9
         return per_cell * self._profile_steps() * 2 * max(cells, 1)
@@ -1215,10 +1296,18 @@ class QueryRouter:
 
         budget_s = self.ragged_chunk_budget_s()
         # the cone cap applies only to cross-contract windows (>= 2
-        # origins): single-origin windows keep one-launch-per-window
+        # origins) on the XLA path: every novel mixed-chunk composition
+        # is a fresh combined rectangle there, i.e. a fresh XLA compile
+        # inside the dispatch deadline. The shape-polymorphic Pallas
+        # kernel pays no per-shape compile, so the cap — and the
+        # compile-ratio auto default behind it — retires on that path;
+        # the byte / var-space / round budgets below still chunk.
+        from mythril_tpu.tpu import pallas_kernel
+
         cone_cap = 0
-        if len({unit.origin for unit in window
-                if unit.origin is not None}) >= 2:
+        if (pallas_kernel.kernel_mode() != "pallas"
+                and len({unit.origin for unit in window
+                         if unit.origin is not None}) >= 2):
             cone_cap = self.ragged_chunk_cones \
                 or (self._auto_chunk_cones() if self._evidence_mode()
                     else 0)
@@ -1389,11 +1478,22 @@ class QueryRouter:
         "cost" means one ragged round over just this cone's REAL gates
         plus the amortized stream prep already blows the round budget.
         Cones inside the level x cell floor stay exempt from the cost
-        check — the round-5 admission guarantee holds in both modes."""
+        check — the round-5 admission guarantee holds in both modes.
+
+        On the Pallas path admission is MEMORY-BUDGET-ONLY ("tiny" and
+        "cap" survive, "cost" does not): the shape-polymorphic kernel
+        pays no per-shape compile and steps only the stream's real
+        gates, and the chunker's round budget still splits oversized
+        windows — a per-cone cost veto here would only starve the
+        device path of exactly the deep cones it now handles."""
         if pc.num_levels <= self.host_direct_levels:
             return "tiny"
         if self.ragged_entry_bytes(pc) > self.ragged_stream_budget:
             return "cap"
+        from mythril_tpu.tpu import pallas_kernel
+
+        if pallas_kernel.kernel_mode() == "pallas":
+            return "device"
         under_floor = (pc.num_levels <= LEVEL_CAP_FLOOR
                        and pc.num_levels * pc.max_width <= self.CELL_FLOOR)
         if (not under_floor

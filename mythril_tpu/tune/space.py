@@ -31,8 +31,8 @@ MIB = 1024 * 1024
 
 class Knob(NamedTuple):
     env: str            # MYTHRIL_TPU_* variable (support/env resolution)
-    kind: str           # "int" | "float"
-    default: Optional[float]   # built-in default; None = platform/auto
+    kind: str           # "int" | "float" | "str" (categorical)
+    default: Optional[object]  # built-in default; None = platform/auto
     stage: str          # roofline stage the knob moves (or subsystem tag)
     candidates: Tuple   # non-default values the search may evaluate
     help: str
@@ -52,6 +52,11 @@ KNOBS: Tuple[Knob, ...] = (
          (32, 128), "min cone depth for the cube second pass"),
     Knob("MYTHRIL_TPU_CPU_DISPATCH_CAP", "int", 2, "kernel",
          (1, 4), "evidence-mode bucketed dispatches per process"),
+    # default None = derived: "auto" picks pallas where jax reports a
+    # real TPU, xla everywhere else (tpu/pallas_kernel.kernel_mode)
+    Knob("MYTHRIL_TPU_KERNEL", "str", None, "kernel",
+         ("xla", "pallas"), "ragged device-kernel backend "
+         "(xla | pallas | auto)"),
     # ragged stage: stream assembly, admission, and window formation
     Knob("MYTHRIL_TPU_RAGGED_STREAM_BYTES", "int", 48 * MIB, "ragged",
          (24 * MIB, 96 * MIB), "memory budget per assembled flat stream"),
@@ -101,14 +106,19 @@ def knob_names() -> Tuple[str, ...]:
 
 def validate_knobs(mapping) -> bool:
     """True iff every (name, value) pair names a registered knob with a
-    plausible numeric value — the tuned-profile apply gate."""
+    plausible value for its kind — the tuned-profile apply gate. Numeric
+    knobs take int/float; "str" (categorical) knobs take one of their
+    registered candidate strings."""
     if not isinstance(mapping, dict) or not mapping:
         return False
     for name, value in mapping.items():
         registered = _BY_ENV.get(name)
         if registered is None:
             return False
-        if isinstance(value, bool) or not isinstance(value, (int, float)):
+        if registered.kind == "str":
+            if not isinstance(value, str) or value not in registered.candidates:
+                return False
+        elif isinstance(value, bool) or not isinstance(value, (int, float)):
             return False
     return True
 

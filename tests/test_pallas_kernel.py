@@ -1,0 +1,196 @@
+"""Differential property test for the shape-polymorphic Pallas kernel
+(tpu/pallas_kernel.py) against the XLA ragged round and host AIG
+evaluation.
+
+300 random brute-force-verified cone entries — plain cones, cube
+replicas (`extra_roots` pins), and fork carry-literal pins
+(`carry_lits`) — ride mixed windows through BOTH device kernels:
+
+  * soundness  every (cone, lane) either backend flags found decodes to
+               a model the host AIG evaluation confirms, pinned
+               literals included;
+  * completeness / found-mask parity  each backend's found cone set
+               equals the brute-force SAT set exactly (an UNSAT entry
+               can never verify, so the two backends' found-masks are
+               identical by construction once both match the oracle);
+  * zero recompiles  every window shape reuses the ONE compiled Pallas
+               round (the property the whole kernel design buys).
+
+Runs in Pallas interpret mode on CPU (tier-1), native on TPU.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from mythril_tpu.preanalysis import cubes as cubes_mod
+from mythril_tpu.smt.solver.statistics import SolverStatistics
+from mythril_tpu.tpu import pallas_kernel
+from mythril_tpu.tpu.circuit import PackedCircuit, RaggedStream
+from tests.test_ragged import (_bruteforce_sat, _eval_root,
+                               _local_to_global, _random_cone)
+
+TOTAL_ENTRIES = 300
+WINDOW = 60          # entries per mixed stream (cone_slots stays 64)
+MAX_ROUNDS = 6       # completeness retries before the oracle must match
+
+
+@pytest.fixture(autouse=True)
+def fresh_stats():
+    stats = SolverStatistics()
+    stats.reset()
+    stats.enabled = True
+    yield
+    stats.reset()
+
+
+def _small_cone(rng):
+    """A small packed cone (bounded inputs so the brute-force oracle
+    stays exact and cheap)."""
+    while True:
+        aig, roots = _random_cone(rng, rng.randint(3, 6),
+                                  rng.randint(8, 24))
+        pc = PackedCircuit(aig, roots)
+        if pc.ok:
+            return aig, roots, pc
+
+
+def _pin_lits(pc, pins):
+    """(local var, want) pins as GLOBAL root literals for the oracle."""
+    return [(pc.var_map[lvar] << 1) | (0 if want else 1)
+            for lvar, want in pins]
+
+
+def _build_entries():
+    """300 oracle-labeled entries: (pc, extra_roots, aig, roots, pins,
+    expected_sat). Plain entries are filtered SAT (mirrors production:
+    UNSAT cones rarely assemble); cube/fork entries keep whatever label
+    the oracle assigns — pinning both polarities MUST produce UNSAT
+    replicas the kernels must not 'find'."""
+    rng = random.Random(0xD1FF)
+    entries = []
+
+    while len(entries) < 120:  # plain cones
+        aig, roots, pc = _small_cone(rng)
+        if _bruteforce_sat(aig, roots):
+            entries.append((pc, (), aig, roots, (), True))
+
+    cube_cones = 0
+    while cube_cones < 24:  # cube replicas: 24 cones x 4 cubes
+        aig, roots, pc = _small_cone(rng)
+        plan = cubes_mod.plan_cubes(pc, 2, 1000)
+        if len(plan) != 4 or not _bruteforce_sat(aig, roots):
+            continue
+        cube_cones += 1
+        for cube in plan:
+            expected = _bruteforce_sat(aig, roots + _pin_lits(pc, cube))
+            entries.append((pc, tuple(cube), aig, roots, tuple(cube),
+                            expected))
+
+    fork_cones = 0
+    while fork_cones < 42:  # fork carry pins: 42 cones x 2 sides
+        aig, roots, _pc = _small_cone(rng)
+        gates = [v for v in range(1, aig.num_vars + 1)
+                 if aig.gate_lhs[v] != -1 and (v << 1) != roots[0]]
+        if not gates or not _bruteforce_sat(aig, roots):
+            continue
+        carry = rng.choice(gates) << 1
+        pc = PackedCircuit(aig, roots, carry_lits=(carry,))
+        if not pc.ok or (carry >> 1) not in pc.carry_local:
+            continue
+        fork_cones += 1
+        lvar = pc.carry_local[carry >> 1]
+        for want in (True, False):
+            pins = ((lvar, want),)
+            expected = _bruteforce_sat(aig, roots + _pin_lits(pc, pins))
+            entries.append((pc, pins, aig, roots, pins, expected))
+
+    assert len(entries) == TOTAL_ENTRIES
+    rng.shuffle(entries)  # windows mix plain + cube + fork entries
+    return entries
+
+
+def _run_xla_window(stream, seed, steps):
+    import jax
+
+    from mythril_tpu.tpu.circuit import run_round_ragged
+
+    jnp = jax.numpy
+    tensors = {k: jnp.asarray(v) for k, v in stream.tensors.items()}
+    key = jax.random.PRNGKey(seed)
+    key, init_key = jax.random.split(key)
+    x = jax.random.bernoulli(
+        init_key, 0.5, (8, stream.v1)).astype(jnp.int32)
+    x, found = run_round_ragged(tensors, x, key, steps=steps,
+                                walk_depth=stream.num_levels + 4)
+    return np.asarray(x), np.asarray(found)[:, : stream.num_cones]
+
+
+def _run_pallas_window(stream, seed, steps):
+    import jax
+
+    caps = pallas_kernel.kernel_caps()
+    flat = pallas_kernel.flatten_stream(stream, caps)
+    assert flat is not None, "test windows must fit the default caps"
+    flat = pallas_kernel.device_flat(jax, flat)
+    lanes = pallas_kernel.pad_lanes(8, caps)
+    key = jax.random.PRNGKey(seed)
+    x = jax.random.bernoulli(
+        key, 0.5, (lanes, caps.var_cap)).astype(jax.numpy.int32)
+    x, found = pallas_kernel.run_round_pallas(
+        flat, x, seed=seed * 7919 + 13, steps=steps,
+        walk_depth=stream.num_levels + 4, caps=caps,
+        interpret=pallas_kernel.interpret_mode())
+    return np.asarray(x), np.asarray(found)[:, : stream.num_cones]
+
+
+def _differential_windows(run_window, backend_name):
+    entries = _build_entries()
+    for wi in range(0, TOTAL_ENTRIES, WINDOW):
+        window = entries[wi: wi + WINDOW]
+        stream = RaggedStream([(pc, extra)
+                               for pc, extra, *_rest in window])
+        assert stream.ok and stream.cone_slots >= stream.num_cones
+        expected = np.array([e[5] for e in window])
+        found_any = np.zeros((len(window),), dtype=bool)
+        witnesses = {}
+        for round_idx in range(MAX_ROUNDS):
+            x, found = run_window(stream, seed=1000 * wi + round_idx,
+                                  steps=64 + 32 * round_idx)
+            for ci in np.nonzero(found.any(axis=0))[0]:
+                if not found_any[ci]:
+                    found_any[ci] = True
+                    witnesses[int(ci)] = (x, int(np.argmax(found[:, ci])))
+            if (found_any == expected).all():
+                break
+        # found-mask parity: each backend must match the brute-force
+        # oracle exactly — never finding an UNSAT entry, never missing
+        # a SAT one (hence both backends' masks are identical)
+        assert (found_any == expected).all(), (
+            backend_name, wi, np.nonzero(found_any != expected)[0])
+        # soundness: every witness re-verifies on the host AIG,
+        # pinned literals included
+        for ci, (x, lane) in witnesses.items():
+            pc, _extra, aig, roots, pins, _sat = window[ci]
+            local = stream.cone_assignment(ci, x[lane][: stream.v1])
+            assignment = _local_to_global(pc, local)
+            for root in roots:
+                assert _eval_root(aig, assignment, root), \
+                    (backend_name, wi, ci, root)
+            for lvar, want in pins:
+                assert bool(local[lvar]) == want, \
+                    (backend_name, wi, ci, "pin", lvar)
+
+
+def test_xla_kernel_matches_bruteforce_oracle():
+    _differential_windows(_run_xla_window, "xla")
+
+
+def test_pallas_kernel_matches_bruteforce_oracle_zero_recompiles():
+    pallas_kernel.reset_kernel_mode()
+    before = pallas_kernel._round_fn.cache_info().currsize
+    _differential_windows(_run_pallas_window, "pallas")
+    info = pallas_kernel._round_fn.cache_info()
+    assert info.currsize <= before + 1, \
+        "every window shape must reuse ONE compiled Pallas round"

@@ -134,9 +134,24 @@ def test_padding_cone_slots_carry_empty_root_masks():
     rng = random.Random(17)
     cones = _packed_cones(rng, 3)
     stream = RaggedStream([(pc, ()) for _a, _r, pc in cones])
-    assert stream.cone_slots >= 4  # pow2 ramp over 3 real cones
+    assert stream.cone_slots >= stream.num_cones
+    assert stream.cone_slots == 4  # pow2 ramp over 3 real cones
     mask = stream.tensors["root_mask"]
     assert mask[3:].sum() == 0, "padding slots must assert nothing"
+
+
+def test_cone_slot_ramp_stops_at_window_cap():
+    """The pow2 slot ramp must not double past the coalescing window
+    cone cap: a 65-cone window (cube replicas) gets 65 root-table rows,
+    not 128."""
+    rng = random.Random(19)
+    (_aig, _roots, pc), = _packed_cones(rng, 1)
+    stream = RaggedStream([(pc, ())] * 65)
+    assert stream.ok
+    assert stream.cone_slots == 65
+    assert stream.cone_slots >= stream.num_cones
+    small = RaggedStream([(pc, ())] * 5)
+    assert small.cone_slots == 8, "pow2 ramp still applies under the cap"
 
 
 # -- kernel correctness ------------------------------------------------------

@@ -470,3 +470,97 @@ def test_ragged_scheduler_window_widens(monkeypatch):
     monkeypatch.setenv("MYTHRIL_TPU_RAGGED", "1")
     monkeypatch.setenv("MYTHRIL_TPU_COALESCE_MAX", "5")
     assert sched_mod.CoalescingScheduler().max_batch == 5
+
+
+@pytest.fixture
+def kernel_mode(monkeypatch):
+    """Force MYTHRIL_TPU_KERNEL for a test and restore the process-cached
+    resolution afterwards (pallas_kernel.kernel_mode() memoizes)."""
+    from mythril_tpu.tpu import pallas_kernel
+
+    def set_mode(mode):
+        monkeypatch.setenv("MYTHRIL_TPU_KERNEL", mode)
+        pallas_kernel.reset_kernel_mode()
+
+    yield set_mode
+    monkeypatch.delenv("MYTHRIL_TPU_KERNEL", raising=False)
+    pallas_kernel.reset_kernel_mode()
+
+
+def test_ragged_admission_memory_budget_only_on_pallas(monkeypatch,
+                                                       kernel_mode):
+    """On the Pallas path the per-cone COST veto retires from ragged
+    admission: "tiny" and the stream memory budget survive, but a cone
+    whose single-round estimate busts the chunk budget is still admitted
+    (the shape-polymorphic kernel pays no per-shape compile and the
+    chunker's round budget bounds the window). The XLA path keeps the
+    cost check."""
+    monkeypatch.setenv("MYTHRIL_TPU_RAGGED", "1")
+    kernel_mode("xla")
+    deep = FakePC(700, width=1024)  # dense: real rows match the padding
+    router = QueryRouter(FakeBackend())
+    cells = router.ragged_round_cells(deep)
+    # latency at which ONE ragged round over this cone alone costs twice
+    # the chunk budget
+    router._per_cell_s = (2.0 * router.ragged_chunk_budget_s()
+                          / (router._profile_steps() * 2 * cells))
+    assert router._admission_ragged(deep) == "cost"
+    kernel_mode("pallas")
+    router._per_cell_s = (2.0 * router.ragged_chunk_budget_s()
+                          / (router._profile_steps() * 2 * cells))
+    assert router._admission_ragged(deep) == "device"
+    # the host propagation shortcut survives the widening
+    assert (router._admission_ragged(FakePC(router.host_direct_levels))
+            == "tiny")
+    # ... and so does the per-cone memory budget
+    monkeypatch.setenv("MYTHRIL_TPU_RAGGED_STREAM_BYTES",
+                       str(QueryRouter.ragged_entry_bytes(deep) - 1))
+    assert QueryRouter(FakeBackend())._admission_ragged(deep) == "cap"
+
+
+def test_ragged_mixed_origin_cone_cap_retires_on_pallas(monkeypatch,
+                                                        kernel_mode):
+    """The mixed-origin chunk-cone cap is an XLA compile-pressure guard
+    (every novel cross-contract chunk composition is a fresh combined
+    rectangle there); the Pallas path compiles once per capacity
+    rectangle, so the cap must not chunk its windows — the byte / var /
+    round budgets still do."""
+    monkeypatch.setenv("MYTHRIL_TPU_RAGGED", "1")
+    monkeypatch.setenv("MYTHRIL_TPU_RAGGED_CHUNK_CONES", "2")
+    kernel_mode("xla")
+    router = QueryRouter(FakeBackend())
+    pcs = [FakePC(300) for _ in range(6)]
+    window = [router_mod._Unit(i, None, pc, problem(pc),
+                               origin="even" if i % 2 == 0 else "odd")
+              for i, pc in enumerate(pcs)]
+    assert [len(c) for c in router._chunk_ragged(window)] == [2, 2, 2]
+    kernel_mode("pallas")
+    assert [len(c) for c in router._chunk_ragged(window)] == [6]
+
+
+def test_ragged_cost_model_charges_measured_pallas_rate(kernel_mode):
+    """est_ragged_round_seconds charges the MEASURED pallas_cells_s rate
+    on the Pallas path (falling back to the XLA per-cell constant when
+    the micro-calibration has not run), and attainable_rates ranks the
+    roofline's kernel stage against the kernel actually running."""
+    kernel_mode("xla")
+    router = QueryRouter(FakeBackend())
+    router._per_cell_s = 1e-6
+    router._stage_rates["pallas_cells_s"] = 4e7
+    steps2 = router._profile_steps() * 2
+    assert router.est_ragged_round_seconds(1000) == pytest.approx(
+        1e-6 * steps2 * 1000)
+    assert router.attainable_rates()["kernel_cells_s"] == pytest.approx(
+        1e6)
+    kernel_mode("pallas")
+    assert router.est_ragged_round_seconds(1000) == pytest.approx(
+        (1.0 / 4e7) * steps2 * 1000)
+    assert router.attainable_rates()["kernel_cells_s"] == pytest.approx(
+        4e7)
+    # no measured pallas rate yet: the XLA constant still bounds the
+    # estimate (conservative until the micro-calibration runs)
+    del router._stage_rates["pallas_cells_s"]
+    assert router.est_ragged_round_seconds(1000) == pytest.approx(
+        1e-6 * steps2 * 1000)
+    assert router.attainable_rates()["kernel_cells_s"] == pytest.approx(
+        1e6)

@@ -100,8 +100,11 @@ def test_every_knob_is_well_formed():
     assert len(space.KNOBS) >= 12
     for knob in space.KNOBS:
         assert knob.env.startswith("MYTHRIL_TPU_")
-        assert knob.kind in ("int", "float")
+        assert knob.kind in ("int", "float", "str")
         assert knob.candidates, knob.env
+        if knob.kind == "str":
+            assert all(isinstance(c, str) for c in knob.candidates), \
+                knob.env
     assert len(set(space.knob_names())) == len(space.KNOBS)
 
 

@@ -83,6 +83,13 @@ TRAJECTORY_METRICS = (
     # stale and must be re-tuned; the trajectory table catches it
     "tuned.speedup",
     "tuned.findings_equal",
+    # device-kernel backend paired leg: Pallas findings parity and the
+    # zero-recompile property flipping false (or the cell counter going
+    # dark) means the shape-polymorphic kernel stopped engaging
+    "kernel.findings_equal",
+    "kernel.zero_recompile_pallas",
+    "kernel.pallas_cells_stepped",
+    "kernel.recompiles_pallas",
 )
 
 _HIGHER_BETTER_RE = re.compile(
@@ -101,10 +108,17 @@ _HIGHER_BETTER_RE = re.compile(
     r"|zero_contamination|clean_drain"
     # autotune: the tuned profile going dark (knobs_applied -> 0)
     # silently reverts every leg to built-in defaults
-    r"|knobs_applied)")
+    r"|knobs_applied"
+    # Pallas kernel: launches/cells going dark means the backend fell
+    # back to XLA; the zero-recompile verdict flipping false breaks the
+    # tentpole shape-polymorphism property (checked BEFORE the
+    # lower-better `recompiles` pattern — order matters)
+    r"|pallas_launches|pallas_cells|zero_recompile)")
 _LOWER_BETTER_RE = re.compile(
     r"(_s$|wall|cap_rejects|cdcl_settles|sol_gap|misses|fallbacks"
-    r"|verify_rejects|degraded|deadline_trips|breaker_trips)")
+    r"|verify_rejects|degraded|deadline_trips|breaker_trips"
+    # per-window-shape kernel recompiles: every one is a paid jit
+    r"|recompiles)")
 
 
 def direction(metric: str) -> int:
@@ -232,6 +246,15 @@ def extract_metrics(payload: dict) -> Dict[str, object]:
     put("tuned.contracts_per_hour", tuned.get("contracts_per_hour_tuned"))
     put("tuned.findings_equal", tuned.get("findings_equal"))
     put("tuned.knobs_applied", tuned.get("tuned_knobs_applied"))
+    kernel = (extra.get("kernel_backend") or {}).get("summary") or {}
+    put("kernel.findings_equal", kernel.get("findings_equal_all"))
+    put("kernel.zero_recompile_pallas",
+        kernel.get("zero_recompile_pallas"))
+    put("kernel.pallas_launches", kernel.get("pallas_launches_total"))
+    put("kernel.pallas_cells_stepped",
+        kernel.get("pallas_cells_stepped_total"))
+    put("kernel.recompiles_xla", kernel.get("recompiles_xla"))
+    put("kernel.recompiles_pallas", kernel.get("recompiles_pallas"))
     xcontract = extra.get("corpus_xcontract") or {}
     put("xcontract.contracts_per_hour",
         xcontract.get("contracts_per_hour"))

@@ -215,6 +215,47 @@ def main(argv) -> int:
     probe.reset()
     probe.enabled = was_enabled
 
+    # 9. the Pallas device-kernel counters and the kernel_backend stamp:
+    # pinned BY NAME like invariant 8 — the launch counter must sum its
+    # cell volume, the recompile ledger must reach every consumer, and
+    # the backend stamp must ride the stats JSON so every bench leg says
+    # WHICH kernel produced its numbers
+    from mythril_tpu.smt.solver.statistics import PALLAS_KERNEL_COUNTERS
+
+    for name in PALLAS_KERNEL_COUNTERS:
+        if name not in fields:
+            failures.append(
+                f"pinned pallas counter {name!r} is not a "
+                "SolverStatistics field")
+        if name not in emitted:
+            failures.append(
+                f"pinned pallas counter {name!r} missing from the "
+                "stats JSON emission (as_dict)")
+        if name not in routed:
+            failures.append(
+                f"pinned pallas counter {name!r} missing from "
+                "bench.py ROUTING_KEYS roll-up")
+    if not isinstance(emitted_dict.get("kernel_backend"), str):
+        failures.append(
+            "as_dict() does not emit the \"kernel_backend\" stamp "
+            "(which compiled kernel served the run)")
+    probe.reset()
+    probe.enabled = True
+    probe.add_pallas_launch(cells=640)
+    probe.add_pallas_launch(cells=128)
+    probe.add_kernel_recompile()
+    if probe.pallas_launches != 2 or probe.pallas_cells_stepped != 768:
+        failures.append(
+            "add_pallas_launch does not advance pallas_launches / "
+            f"pallas_cells_stepped ({probe.pallas_launches}, "
+            f"{probe.pallas_cells_stepped})")
+    if probe.kernel_recompiles != 1:
+        failures.append(
+            "add_kernel_recompile does not advance kernel_recompiles "
+            f"({probe.kernel_recompiles})")
+    probe.reset()
+    probe.enabled = was_enabled
+
     registered = {inst.name for inst in metrics.REGISTRY}
     unregistered = sorted(set(fields) - registered)
     if unregistered:
