@@ -1,0 +1,76 @@
+"""Sharded serve fleet: multi-process workers behind one front door.
+
+Every acceleration layer through PR 16 lives inside one process: the
+GIL and the process-global term table cap a single daemon's throughput
+no matter how fast the solver stack gets. This package breaks that
+ceiling with three pieces:
+
+  router      (router.py) a rendezvous-hash shard router keyed on the
+              request's content digest (domain-separated with the
+              FINGERPRINT SCHEMA version), so identical bytecode from
+              DIFFERENT tenants lands on the same shard's warm memory
+              tier — the cross-user shared-prefix observation behind
+              ragged paged attention's serving story, applied to solve
+              cones. Registered fault site fleet.route (disable):
+              faults degrade to round-robin placement for the session.
+  netstore    (netstore.py) the content-addressed disk tier promoted to
+              a shared NETWORK tier: an object-store-style directory
+              (MYTHRIL_TPU_NET_TIER_DIR) every shard mounts, with the
+              PR-8 stale-lock discipline. Entries are safe to serve
+              from anywhere because every SAT hit replay-verifies
+              through Solver._reconstruct against the ORIGINAL
+              constraints before being trusted; a corrupt shared entry
+              quarantines on the READING shard as a safe miss
+              (registered fault site netstore.entry).
+  supervisor  (supervisor.py + worker.py) each shard worker is a full
+              engine process running the PR-13 daemon (admission,
+              per-tenant budgets, cross-request batching, SIGTERM
+              drain); the supervisor health-probes, crash-only restarts
+              dead shards (they re-warm from the shared tier), and
+              re-routes a failed shard's in-flight requests once to a
+              surviving shard (registered fault site fleet.shard).
+
+Knobs (all env; see README "Serve fleet"):
+  MYTHRIL_TPU_FLEET_SHARDS          worker count for `serve --shards`
+                                    (CLI flag wins; 1 = single-process)
+  MYTHRIL_TPU_NET_TIER_DIR          shared network-tier directory; unset
+                                    = each process keeps a private disk
+                                    tier under MYTHRIL_TPU_CACHE_DIR
+  MYTHRIL_TPU_FLEET_PROBE_INTERVAL  supervisor health-probe cadence
+                                    seconds (2.0)
+  MYTHRIL_TPU_FLEET_START_TIMEOUT   per-shard start/announce wait
+                                    seconds (120)
+"""
+
+import os
+
+from mythril_tpu.support.env import env_float
+
+FLEET_SHARDS_ENV = "MYTHRIL_TPU_FLEET_SHARDS"
+NET_TIER_DIR_ENV = "MYTHRIL_TPU_NET_TIER_DIR"
+PROBE_INTERVAL_ENV = "MYTHRIL_TPU_FLEET_PROBE_INTERVAL"
+START_TIMEOUT_ENV = "MYTHRIL_TPU_FLEET_START_TIMEOUT"
+
+DEFAULT_PROBE_INTERVAL_S = 2.0
+DEFAULT_START_TIMEOUT_S = 120.0
+
+
+def fleet_shards(cli_value=None) -> int:
+    """Resolved shard count: CLI flag > env > 1 (single-process)."""
+    if cli_value:
+        return max(1, int(cli_value))
+    return max(1, int(env_float(FLEET_SHARDS_ENV, 1)))
+
+
+def net_tier_dir() -> str:
+    """The shared network-tier root ('' = no network tier mounted)."""
+    return os.environ.get(NET_TIER_DIR_ENV) or ""
+
+
+def probe_interval_s() -> float:
+    return max(0.05, env_float(PROBE_INTERVAL_ENV,
+                               DEFAULT_PROBE_INTERVAL_S))
+
+
+def start_timeout_s() -> float:
+    return max(1.0, env_float(START_TIMEOUT_ENV, DEFAULT_START_TIMEOUT_S))

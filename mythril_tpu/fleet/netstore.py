@@ -1,0 +1,57 @@
+"""The shared NETWORK result tier: the content-addressed disk tier
+(service/store.py) promoted to an object-store-style directory every
+shard in the fleet mounts (MYTHRIL_TPU_NET_TIER_DIR).
+
+Nothing about the entry format changes — that is the point. The tier's
+trust model was location-independent from the start:
+
+  SAT    a hit is NEVER trusted as-is; the caller replays the stored
+         assignment bits through Solver._reconstruct, which validates
+         the rebuilt model against the ORIGINAL constraints. A
+         fingerprint collision, a torn cross-host write, or a stale
+         entry from another shard degrades to a safe miss, never a
+         wrong verdict — which is exactly what makes the entries safe
+         to serve from a directory ANY process can write.
+  UNSAT  crosscheck provenance gates detection-path trust, same as the
+         local tier.
+
+What does change is the failure domain: a corrupt entry may now have
+been written by a DIFFERENT shard. The subclass therefore carries its
+own registered fault site (netstore.entry, quarantine): the READING
+shard quarantines the entry and safe-misses — counted
+net_tier_verify_rejects so the fleet /metrics rollup can see
+cross-shard corruption separately from local-tier rot — while the
+writing shard keeps running untouched. Writes reuse the PR-8
+stale-lock discipline (support/lock.py) against the shared directory,
+so a shard that dies mid-write can never wedge the tier for its
+siblings: the lock's owner-pid liveness probe and max-age break apply
+across the fleet.
+"""
+
+import logging
+from typing import Optional
+
+from mythril_tpu.fleet import net_tier_dir
+from mythril_tpu.service.store import PersistentResultStore
+
+log = logging.getLogger(__name__)
+
+
+class NetworkResultStore(PersistentResultStore):
+    """PersistentResultStore pointed at the fleet-shared directory,
+    with the netstore.entry fault site on its read path."""
+
+    is_network = True
+    entry_site = "netstore.entry"
+
+    def __init__(self, root: Optional[str] = None,
+                 max_entries: Optional[int] = None,
+                 max_bytes: Optional[int] = None):
+        super().__init__(root=root or net_tier_dir() or None,
+                         max_entries=max_entries, max_bytes=max_bytes)
+
+    def _entry_guard(self, text: str) -> str:
+        from mythril_tpu.resilience import corrupt_text, maybe_inject
+
+        maybe_inject("netstore.entry")
+        return corrupt_text("netstore.entry", text)

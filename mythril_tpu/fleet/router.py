@@ -1,0 +1,72 @@
+"""Digest-keyed shard router: rendezvous (highest-random-weight) hashing
+over the live shard set.
+
+The key is the request's bytecode content digest, domain-separated with
+the FINGERPRINT SCHEMA version — the same schema that keys the
+content-addressed result tiers — so identical bytecode from DIFFERENT
+tenants deterministically lands on the same shard and hits that shard's
+warm memory tier (memory tier, quick-sat deque, prefix snapshots),
+while a schema bump naturally re-shards alongside the tier wipe.
+
+Rendezvous hashing instead of modulo: when a shard dies, only the keys
+that scored it highest move (to their second-choice shard) — every
+other key keeps its warm shard. Modulo would reshuffle almost the whole
+key space on any membership change, cold-starting the entire fleet.
+
+Registered fault site fleet.route (disable): any fault in the scoring —
+injected or real — degrades to round-robin placement for the session
+(fuse after repeated faults). Requests still land on a live shard;
+only warm-tier affinity is lost. Every decision counts
+fleet_shard_routes.
+"""
+
+import hashlib
+from typing import List, Optional, Sequence
+
+from mythril_tpu.service.fingerprint import FINGERPRINT_SCHEMA
+
+
+def request_digest(code: str) -> str:
+    """Content digest of a request's bytecode — the routing key (the
+    same sha256 the daemon folds into its tenant-qualified origins)."""
+    return hashlib.sha256(code.encode()).hexdigest()
+
+
+def _score(digest: str, shard_id: int) -> int:
+    raw = hashlib.sha256(
+        b"mythril-tpu-fleet-route-v%d:%s:%d"
+        % (FINGERPRINT_SCHEMA, digest.encode(), shard_id)).digest()
+    return int.from_bytes(raw[:8], "big")
+
+
+class ShardRouter:
+    def __init__(self, shard_ids: Sequence[int]):
+        self.shard_ids: List[int] = list(shard_ids)
+        self._rr = 0
+
+    def route(self, digest: str,
+              live: Optional[Sequence[int]] = None) -> Optional[int]:
+        """Pick the shard for `digest` among `live` (default: all
+        registered shards). None only when no shard is live at all."""
+        from mythril_tpu import resilience
+        from mythril_tpu.resilience import maybe_inject
+        from mythril_tpu.smt.solver.statistics import SolverStatistics
+
+        candidates = list(live) if live is not None else self.shard_ids
+        if not candidates:
+            return None
+        shard = None
+        if not resilience.fuse_blown("fleet.route"):
+            try:
+                maybe_inject("fleet.route")
+                shard = max(candidates,
+                            key=lambda sid: _score(digest, sid))
+            except Exception:
+                resilience.note_stage_failure("fleet.route")
+                shard = None
+        if shard is None:
+            # round-robin degradation: still a live shard, no affinity
+            shard = candidates[self._rr % len(candidates)]
+            self._rr += 1
+        SolverStatistics().add_fleet_route()
+        return shard

@@ -1,0 +1,78 @@
+"""One fleet shard: a full engine process running the PR-13 serve
+daemon, launched by the supervisor as
+
+    python -m mythril_tpu.fleet.worker --shard-id N --announce PATH
+
+The worker owns everything the single-process daemon owns — bounded
+admission, per-tenant budgets, cross-request interleaved batches, warm
+per-tenant contexts, the serve.* fault sites, SIGTERM drain — and adds
+nothing: shard-ness lives entirely in the supervisor's routing and in
+the shared network tier the worker mounts through
+MYTHRIL_TPU_NET_TIER_DIR (inherited env). With the network tier
+mounted, the worker forces disk-tier cache mode so every verdict it
+settles is published where the whole fleet can serve it, and a
+crash-only restart re-warms from what the previous incarnation (and
+every sibling shard) already stored.
+
+The announce file ({"pid", "port", "shard_id"}, atomic rename) is the
+start handshake: the worker binds an ephemeral port (the supervisor
+never guesses), writes the announcement, then blocks until drained.
+SIGTERM drains: in-flight requests finish, the listener answers until
+the last one resolves, then the process exits 0.
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+log = logging.getLogger(__name__)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="mythril_tpu.fleet.worker")
+    parser.add_argument("--shard-id", type=int, required=True)
+    parser.add_argument("--announce", required=True)
+    parser.add_argument("--tx-count", type=int, default=1)
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--modules", default=None)
+    parsed = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format=f"%(asctime)s shard-{parsed.shard_id} %(levelname)s "
+               "%(name)s: %(message)s")
+    from mythril_tpu.fleet import net_tier_dir
+    from mythril_tpu.serve.daemon import (
+        ServeDaemon,
+        install_signal_handlers,
+    )
+    from mythril_tpu.service.store import atomic_write_json
+    from mythril_tpu.support.args import args as global_args
+    from mythril_tpu.tune import apply_tuned_profile
+
+    apply_tuned_profile()
+    if net_tier_dir():
+        # publish every settled verdict into the fleet-shared tier
+        global_args.solve_cache = "disk"
+    daemon = ServeDaemon(
+        tx_count=parsed.tx_count,
+        modules=parsed.modules.split(",") if parsed.modules else None,
+        http_port=parsed.port)
+    daemon.start()
+    install_signal_handlers(daemon)
+    if not atomic_write_json(parsed.announce, {
+            "pid": os.getpid(),
+            "port": daemon.port,
+            "shard_id": parsed.shard_id}):
+        log.error("could not write announce file %s", parsed.announce)
+        daemon.drain(timeout=0.0)
+        return 1
+    log.info("shard %d serving on port %d (announce %s)",
+             parsed.shard_id, daemon.port, parsed.announce)
+    daemon.drained.wait()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -103,6 +103,11 @@ def build_parser() -> argparse.ArgumentParser:
         "--port", type=int, default=None,
         help="localhost listener port (0 = ephemeral; default: "
              f"MYTHRIL_TPU_SERVE_PORT or 8311)")
+    serve.add_argument(
+        "--shards", type=int, default=None,
+        help="worker-process count: >1 runs the sharded fleet "
+             "(supervisor + digest-routed engine workers; default: "
+             "MYTHRIL_TPU_FLEET_SHARDS or 1 = single process)")
     serve.add_argument("-v", "--verbose", type=int, default=2,
                        help="log level 0-5")
     add_analysis_args(serve)
@@ -467,9 +472,23 @@ def execute_command(parsed) -> int:
         port = parsed.port
         if port is None:
             port = int(os.environ.get(PORT_ENV) or DEFAULT_PORT)
+        modules = (parsed.modules.split(",")
+                   if parsed.modules else None)
+        from mythril_tpu.fleet import fleet_shards
+
+        shards = fleet_shards(parsed.shards)
+        if shards > 1:
+            from mythril_tpu.fleet.supervisor import (
+                FleetSupervisor,
+                serve_forever_fleet,
+            )
+
+            supervisor = FleetSupervisor(
+                shards, tx_count=parsed.transaction_count,
+                modules=modules, http_port=port)
+            return serve_forever_fleet(supervisor)
         daemon = ServeDaemon(tx_count=parsed.transaction_count,
-                             modules=(parsed.modules.split(",")
-                                      if parsed.modules else None),
+                             modules=modules,
                              http_port=port)
         return serve_forever(daemon)
 

@@ -267,6 +267,75 @@ def snapshot(seq: int = 0, final: bool = False) -> dict:
     return snap
 
 
+def merge_snapshots(snaps) -> dict:
+    """Fold N process snapshots into one fleet view (the supervisor's
+    /metrics rollup): counters and timers SUM, histograms and per-site
+    resilience events merge, and the ratio gauges are RECOMPUTED from
+    the merged counters — averaging per-process ratios would weight an
+    idle shard equally with a loaded one. The roofline view is omitted:
+    per-stage attainable rates are calibrated per process and do not
+    add across machines. The stamp/seq/ts come from the newest
+    snapshot, so the exposition's freshness gauge reflects the most
+    recent reading in the merge."""
+    from mythril_tpu.smt.solver.statistics import SolverStatistics
+
+    snaps = [snap for snap in snaps if snap]
+    if not snaps:
+        return snapshot()
+    merged = dict(max(snaps, key=lambda s: s.get("ts", 0)))
+    counters: dict = {}
+    for name in SolverStatistics._COUNTERS:
+        counters[name] = sum(
+            int(snap.get("counters", {}).get(name, 0)) for snap in snaps)
+    for name in SolverStatistics._TIMERS:
+        counters[name] = round(sum(
+            float(snap.get("counters", {}).get(name, 0.0))
+            for snap in snaps), 4)
+    merged["counters"] = counters
+
+    def _ratio(numerator: float, denominator: float) -> float:
+        return round(numerator / denominator, 4) if denominator else 0.0
+
+    merged["gauges"] = {
+        "device_occupancy": _ratio(
+            counters["device_dispatched_queries"],
+            counters["device_slots"]),
+        "coalesce_occupancy": _ratio(
+            counters["coalesced_queries"], counters["window_flushes"]),
+        "frontier_batch_occupancy": _ratio(
+            counters["frontier_states_stepped"]
+            + counters["frontier_batch_bails"]
+            + counters["frontier_fork_cohort_rows"],
+            counters["frontier_batch_slots"]),
+        "serve_tenant_window_share": _ratio(
+            counters["serve_batch_requests"],
+            counters["serve_batch_tenants"]),
+    }
+    histograms: dict = {name: {} for name in _HISTOGRAM_NAMES}
+    for snap in snaps:
+        for name, buckets in (snap.get("histograms") or {}).items():
+            section = histograms.setdefault(name, {})
+            for bucket, value in buckets.items():
+                if isinstance(value, (list, tuple)):
+                    record = section.setdefault(bucket, [0, 0.0])
+                    record[0] += int(value[0])
+                    record[1] = round(record[1] + float(value[1]), 4)
+                else:
+                    section[bucket] = section.get(bucket, 0) + int(value)
+    merged["histograms"] = histograms
+    sites: dict = {}
+    for snap in snaps:
+        for site, events in (snap.get("resilience") or {}).items():
+            per_site = sites.setdefault(site, {})
+            for event, count in events.items():
+                per_site[event] = per_site.get(event, 0) + int(count)
+    merged["resilience"] = sites
+    merged["roofline"] = {}
+    merged["pid"] = os.getpid()
+    merged["final"] = all(snap.get("final") for snap in snaps)
+    return merged
+
+
 # -- Prometheus text exposition -----------------------------------------------
 
 _PROM_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
@@ -280,10 +349,17 @@ def _prom_escape(value) -> str:
     return str(value).replace("\\", r"\\").replace('"', r'\"')
 
 
-def prometheus_text(snap: Optional[dict] = None) -> str:
+def prometheus_text(snap: Optional[dict] = None,
+                    scrape_stamp: bool = False) -> str:
     """Render a snapshot in the Prometheus text exposition format — the
     payload the serve daemon's /metrics endpoint will return, written to
-    a file today (MYTHRIL_TPU_PROM) for a textfile collector."""
+    a file today (MYTHRIL_TPU_PROM) for a textfile collector.
+
+    scrape_stamp=True additionally emits the mythril_tpu_snapshot_ts
+    freshness gauge; only the LIVE scrape paths (daemon /metrics, fleet
+    rollup) set it — the file-based exposition stays byte-deterministic
+    for identical counter state, and a file could not prove freshness
+    anyway."""
     snap = snap or snapshot()
     lines = [
         "# HELP mythril_tpu_build_info run stamp (constant 1)",
@@ -294,6 +370,14 @@ def prometheus_text(snap: Optional[dict] = None) -> str:
             _prom_escape(snap.get("platform") or "none"),
             snap.get("schema_version", SCHEMA_VERSION)),
     ]
+    # scrape-freshness stamp: the wall-clock second this snapshot was
+    # taken. /metrics renders a FRESH snapshot per scrape, so the gauge
+    # tracking scrape time is the pinned liveness property (a stale
+    # file-based exposition would show this value freeze)
+    ts = snap.get("ts") if scrape_stamp else None
+    if ts is not None:
+        lines.append("# TYPE mythril_tpu_snapshot_ts gauge")
+        lines.append(f"mythril_tpu_snapshot_ts {ts}")
     for name, value in sorted(snap.get("counters", {}).items()):
         prom = _prom_name(name)
         lines.append(f"# TYPE {prom} counter")

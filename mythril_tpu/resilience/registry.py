@@ -130,6 +130,27 @@ FAULT_SITES: Dict[str, FaultSite] = {
             "thread (the abandoned body cancels at its next check); its "
             "requests requeue into a fresh batch once, then answer "
             "`incomplete` — never hung, siblings' results kept"),
+        FaultSite(
+            "fleet.shard", "fleet/supervisor", "retry",
+            ("raise",),
+            "a dead or faulted shard's in-flight request re-routes once "
+            "to a surviving shard, then answers `incomplete`; the "
+            "supervisor crash-only restarts the shard, which re-warms "
+            "from the shared network tier"),
+        FaultSite(
+            "fleet.route", "fleet/router", "disable",
+            ("raise",),
+            "digest-keyed rendezvous routing degrades to round-robin "
+            "shard placement for the session (fuse after repeated "
+            "faults); requests still land on a live shard, only warm-"
+            "tier affinity is lost"),
+        FaultSite(
+            "netstore.entry", "fleet/netstore", "quarantine",
+            ("corrupt", "raise"),
+            "corrupt shared-tier entry quarantined on the READING "
+            "shard, whose lookup degrades to a safe miss and re-solves; "
+            "the writing shard is untouched (counted "
+            "net_tier_verify_rejects)"),
     )
 }
 

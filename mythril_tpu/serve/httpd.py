@@ -5,9 +5,14 @@ an internet service; put real auth/TLS termination in front of it):
 
   GET  /healthz   liveness + drain state + queue depth + the request
                   admission/disposition counters
-  GET  /metrics   PR 10's Prometheus text writer as a real scrape
-                  endpoint (the same exposition MYTHRIL_TPU_PROM writes
-                  to a file)
+  GET  /metrics   PR 10's Prometheus text exposition rendered from a
+                  FRESH live registry snapshot at scrape time — never
+                  the last heartbeat file write, so scrape freshness is
+                  independent of MYTHRIL_TPU_HEARTBEAT_INTERVAL (the
+                  mythril_tpu_snapshot_ts gauge pins it)
+  GET  /snapshot  the raw live snapshot as JSON (metrics.snapshot()) —
+                  what the fleet supervisor's per-shard /metrics rollup
+                  fetches and merges
   POST /analyze   {"tenant": ..., "code": "0x...", "name"?, "tx_count"?,
                   "deadline_s"?, "bin_runtime"?} -> the request's
                   terminal outcome JSON. Backpressure is an HTTP answer:
@@ -91,9 +96,16 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(code, health)
             return
         if self.path == "/metrics":
+            # a fresh snapshot per scrape (prometheus_text defaults to
+            # one): freshness never depends on the heartbeat cadence
             from mythril_tpu.observe.metrics import prometheus_text
 
-            self._send_text(200, prometheus_text())
+            self._send_text(200, prometheus_text(scrape_stamp=True))
+            return
+        if self.path == "/snapshot":
+            from mythril_tpu.observe.metrics import snapshot
+
+            self._send_json(200, snapshot())
             return
         self._send_json(404, {"error": f"unknown path {self.path}"})
 

@@ -113,6 +113,13 @@ class PersistentResultStore:
     """File-per-entry result store; every method is total (I/O failures
     degrade to miss/no-op — the store must never break a solve)."""
 
+    # the shared network tier (fleet/netstore.py) subclasses this with
+    # is_network=True and its own fault site; model.py keys the
+    # net_tier_* counters off the flag so fleet-wide hits/stores are
+    # visible separately from a private local disk tier
+    is_network = False
+    entry_site = "disk.entry"
+
     def __init__(self, root: Optional[str] = None,
                  max_entries: Optional[int] = None,
                  max_bytes: Optional[int] = None):
@@ -207,15 +214,10 @@ class PersistentResultStore:
                 text = fd.read()
         except OSError:
             return None  # no entry: plain miss
-        from mythril_tpu.resilience import (
-            InjectedFault,
-            corrupt_text,
-            maybe_inject,
-        )
+        from mythril_tpu.resilience import InjectedFault
 
         try:
-            maybe_inject("disk.entry")
-            payload = json.loads(corrupt_text("disk.entry", text))
+            payload = json.loads(self._entry_guard(text))
         except (InjectedFault, ValueError):
             return self._quarantine(path, "unparseable entry")
         if not isinstance(payload, dict) \
@@ -242,6 +244,16 @@ class PersistentResultStore:
             pass
         return entry
 
+    def _entry_guard(self, text: str) -> str:
+        """Fault-harness crossing on the entry read path. The site name
+        stays a LITERAL (the check_fault_sites wiring lint matches
+        literal strings only); the network-tier subclass overrides with
+        its own literal site (netstore.entry)."""
+        from mythril_tpu.resilience import corrupt_text, maybe_inject
+
+        maybe_inject("disk.entry")
+        return corrupt_text("disk.entry", text)
+
     # quarantined corpses kept for forensics; beyond this the oldest are
     # dropped — a recurring corruption source (flaky disk, mixed-version
     # writers) must not grow the cache dir past its caps through files
@@ -259,8 +271,11 @@ class PersistentResultStore:
 
         log.warning("quarantining corrupt solve-cache entry %s (%s)",
                     os.path.basename(path), reason)
-        SolverStatistics().add_persistent_verify_reject()
-        record_event("disk.entry", "quarantine")
+        stats = SolverStatistics()
+        stats.add_persistent_verify_reject()
+        if self.is_network:
+            stats.add_net_tier_verify_reject()
+        record_event(self.entry_site, "quarantine")
         try:
             os.replace(path, path + ".quarantined")
         except OSError:
@@ -453,11 +468,21 @@ _store: Optional[PersistentResultStore] = None
 
 
 def get_result_store() -> PersistentResultStore:
-    """Process-wide store handle (re-reads MYTHRIL_TPU_CACHE_DIR on first
-    access after reset_result_store)."""
+    """Process-wide store handle (re-reads MYTHRIL_TPU_CACHE_DIR and
+    MYTHRIL_TPU_NET_TIER_DIR on first access after reset_result_store).
+    With a network-tier directory mounted, every shard in the fleet
+    shares one object-store-style tier instead of a private disk tier —
+    safe because entries are replay-verified on every hit."""
     global _store
     if _store is None:
-        _store = PersistentResultStore()
+        net_root = os.environ.get("MYTHRIL_TPU_NET_TIER_DIR")
+        if net_root:
+            # lazy import: fleet/ imports service/, not vice versa
+            from mythril_tpu.fleet.netstore import NetworkResultStore
+
+            _store = NetworkResultStore(net_root)
+        else:
+            _store = PersistentResultStore()
     return _store
 
 

@@ -202,6 +202,20 @@ class SolverStatistics:
         "serve_batches",
         "serve_batch_requests",
         "serve_batch_tenants",
+        # sharded serve fleet (mythril_tpu/fleet/): digest-keyed shard
+        # routing decisions, requests re-routed to a surviving shard
+        # after a shard fault, crash-only shard restarts by the
+        # supervisor, and the shared NETWORK result tier — entries
+        # served across processes (replay-verified on every hit),
+        # entries stored into it, and shared entries that failed
+        # replay/provenance verification and were quarantined as safe
+        # misses on the reading shard
+        "fleet_shard_routes",
+        "fleet_requeues",
+        "fleet_shard_restarts",
+        "net_tier_hits",
+        "net_tier_stores",
+        "net_tier_verify_rejects",
         # autotune loop (mythril_tpu/tune/): search candidates measured,
         # candidates rejected by the findings-parity guard / by measuring
         # no better than the default config, tuned knobs actually live
@@ -769,6 +783,48 @@ class SolverStatistics:
         if self.enabled:
             self.serve_drain_wall += seconds
 
+    def add_fleet_route(self, count: int = 1) -> None:
+        """One digest-keyed shard-routing decision (fleet/router.py):
+        the request's code digest picked its shard by rendezvous hash
+        (or round-robin under the fleet.route degradation fuse)."""
+        if self.enabled:
+            self.fleet_shard_routes += count
+
+    def add_fleet_requeue(self, count: int = 1) -> None:
+        """A fleet request re-routed to a surviving shard after its
+        first shard died or faulted mid-proxy — goes around exactly
+        once, then answers `incomplete` (never lost, never hung)."""
+        if self.enabled:
+            self.fleet_requeues += count
+
+    def add_fleet_shard_restart(self, count: int = 1) -> None:
+        """One crash-only shard restart by the fleet supervisor (dead
+        process or repeated health-probe failure); the replacement
+        re-warms from the shared network tier."""
+        if self.enabled:
+            self.fleet_shard_restarts += count
+
+    def add_net_tier_hit(self, count: int = 1) -> None:
+        """A shared network-tier entry served to this process — stored
+        by ANY shard, replay-verified through Solver._reconstruct (SAT)
+        or the UNSAT provenance gate before being trusted. A strict
+        subset of persistent_hits when the network tier is mounted."""
+        if self.enabled:
+            self.net_tier_hits += count
+
+    def add_net_tier_store(self, count: int = 1) -> None:
+        """An entry this process published into the shared network
+        tier, where every shard in the fleet can serve it."""
+        if self.enabled:
+            self.net_tier_stores += count
+
+    def add_net_tier_verify_reject(self, count: int = 1) -> None:
+        """A shared network-tier entry that failed replay/provenance
+        verification on the reading shard — quarantined there as a safe
+        miss; the writing shard keeps running untouched."""
+        if self.enabled:
+            self.net_tier_verify_rejects += count
+
     def add_autotune_candidate(self) -> None:
         """One candidate configuration measured by the autotune search."""
         if self.enabled:
@@ -1087,6 +1143,18 @@ PALLAS_KERNEL_COUNTERS = (
     "pallas_launches",
     "pallas_cells_stepped",
     "kernel_recompiles",
+)
+# the sharded-fleet counters (fleet/ router + supervisor + the shared
+# network result tier), pinned BY NAME like the tuples above: renaming
+# or dropping one must fail tools/check_stats_keys.py, not silently
+# blind the fleet bench leg and the per-shard /metrics rollup
+FLEET_COUNTERS = (
+    "fleet_shard_routes",
+    "fleet_requeues",
+    "fleet_shard_restarts",
+    "net_tier_hits",
+    "net_tier_stores",
+    "net_tier_verify_rejects",
 )
 
 

@@ -200,6 +200,21 @@ def _prep_partition(prep):
         return None
 
 
+def _count_net_tier(store, stats, event: str) -> None:
+    """Mirror a persistent-tier event into the net_tier_* counters when
+    the store is the fleet-shared NETWORK tier (fleet/netstore.py) —
+    cross-process serving must be visible separately from a private
+    local disk tier."""
+    if stats is None or not getattr(store, "is_network", False):
+        return
+    if event == "hit":
+        stats.add_net_tier_hit()
+    elif event == "store":
+        stats.add_net_tier_store()
+    elif event == "reject":
+        stats.add_net_tier_verify_reject()
+
+
 def _probe_component_assembly(store, solver, prep, stats, origin=None):
     """Disk-tier probe at COMPONENT granularity: when the monolithic
     fingerprint misses but every non-trivial component of the partitioned
@@ -244,6 +259,7 @@ def _probe_component_assembly(store, solver, prep, stats, origin=None):
         model = solver._reconstruct(prep, merged)
     except Exception:
         stats.add_persistent_verify_reject()
+        _count_net_tier(store, stats, "reject")
         return None
     for fingerprint in hit_fingerprints:
         _count_xcontract_hit(fingerprint, origin, stats)
@@ -276,6 +292,7 @@ def _persist_component_entries(store, prep, bits, stats,
                 comp_nv, comp_cnf, component.roots, comp_dense)
             if store.store_sat(fingerprint, comp_nv, comp_bits):
                 stats.add_persistent_store()
+                _count_net_tier(store, stats, "store")
             _record_fingerprint_origin(fingerprint, origin)
     except Exception:
         pass  # persistence is best-effort; never break a solve
@@ -320,19 +337,24 @@ def _probe_persistent_store(store, fingerprint, solver, prep, crosscheck,
         assembled = _probe_component_assembly(store, solver, prep, stats,
                                               origin=origin)
         stats.add_persistent_lookup(hit=assembled is not None)
+        if assembled is not None:
+            _count_net_tier(store, stats, "hit")
         return fingerprint, assembled
     if entry.verdict == "sat":
         if entry.num_vars != prep.num_vars:
             stats.add_persistent_verify_reject()
+            _count_net_tier(store, stats, "reject")
             stats.add_persistent_lookup(hit=False)
             return fingerprint, None
         try:
             model = solver._reconstruct(prep, entry.bits)
         except Exception:
             stats.add_persistent_verify_reject()
+            _count_net_tier(store, stats, "reject")
             stats.add_persistent_lookup(hit=False)
             return fingerprint, None
         stats.add_persistent_lookup(hit=True)
+        _count_net_tier(store, stats, "hit")
         _count_xcontract_hit(fingerprint, origin, stats)
         return fingerprint, ("sat", model, True)
     if crosscheck and not entry.crosschecked:
@@ -341,6 +363,7 @@ def _probe_persistent_store(store, fingerprint, solver, prep, crosscheck,
         stats.add_persistent_lookup(hit=False)
         return fingerprint, None
     stats.add_persistent_lookup(hit=True)
+    _count_net_tier(store, stats, "hit")
     _count_xcontract_hit(fingerprint, origin, stats)
     return fingerprint, ("unsat", None, entry.crosschecked)
 
@@ -383,6 +406,7 @@ def _persist_result(fingerprint, prep, status, bits=None,
     _record_fingerprint_origin(fingerprint, origin)
     if stored and stats is not None:
         stats.add_persistent_store()
+        _count_net_tier(store, stats, "store")
 
 
 def get_model(

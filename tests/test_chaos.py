@@ -590,15 +590,17 @@ def test_serve_corrupt_disk_entry_degrades_to_safe_miss_per_request():
 
 def test_every_registered_site_kind_pair_is_exercised_or_unit_tested():
     """Completeness backstop for the chaos matrix: every (site, kind)
-    pair the registry declares must appear in some spec in this file or
-    in test_resilience.py — a registered kind nothing injects is an
-    untested degradation claim. (tools/check_fault_sites.py enforces the
-    site-level version of this in tier-1; this pins the kind level.)"""
+    pair the registry declares must appear in some spec in this file, in
+    test_resilience.py, or in test_fleet.py (the fleet sites' chaos
+    coverage lives with the fleet machinery) — a registered kind nothing
+    injects is an untested degradation claim. (tools/check_fault_sites.py
+    enforces the site-level version of this in tier-1; this pins the
+    kind level.)"""
     from mythril_tpu.resilience import registry
 
     here = os.path.dirname(os.path.abspath(__file__))
     text = ""
-    for name in ("test_chaos.py", "test_resilience.py"):
+    for name in ("test_chaos.py", "test_resilience.py", "test_fleet.py"):
         with open(os.path.join(here, name), encoding="utf-8") as fd:
             text += fd.read()
     specs = set()

@@ -1,0 +1,639 @@
+"""`mythril_tpu serve --shards N` — the sharded serve fleet
+(mythril_tpu/fleet/):
+
+  * routing — digest-keyed rendezvous hashing: deterministic, balanced,
+    and minimally disruptive on membership change (a dead shard moves
+    ONLY its own keys); a faulted router (site fleet.route) degrades to
+    round-robin placement — requests still land on a live shard, only
+    warm-tier affinity is lost;
+  * network tier — the content-addressed disk tier promoted to a shared
+    directory (MYTHRIL_TPU_NET_TIER_DIR): an entry stored by one shard
+    process is hit, replay-verified, and served by ANOTHER shard
+    process; a corrupt shared entry is quarantined on the READING shard
+    as a safe miss (site netstore.entry) without poisoning the writer;
+  * supervisor — sticky proxy routing, the requeue-once-then-incomplete
+    discipline at fleet scope (site fleet.shard), crash-only restart of
+    dead workers, fleet-wide /metrics merged from per-shard snapshots,
+    graceful drain;
+  * /metrics liveness — the single-daemon scrape renders from a FRESH
+    registry snapshot, never the heartbeat file (satellite of this PR).
+
+The fleet fault sites cross process boundaries, so their chaos coverage
+lives here rather than in tests/test_chaos.py (tools/check_fault_sites
+scans this file too). The full-corpus parity soak (4 shards vs the
+single-process daemon, kill-a-shard chaos) lives in tools/soak_serve.py.
+"""
+
+import json
+import os
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from mythril_tpu.resilience import faults
+from mythril_tpu.smt.solver.statistics import SolverStatistics
+from mythril_tpu.support import model as model_mod
+from mythril_tpu.support.args import args as global_args
+
+from tests.test_analysis import KILLBILLY, wrap_creation
+from tests.test_serve import _solo_issues
+
+
+def _full_reset():
+    from mythril_tpu import preanalysis
+    from mythril_tpu.resilience import deadline as deadline_mod
+    from mythril_tpu.tpu import router as router_mod
+
+    model_mod.clear_caches()  # also drops session fuses
+    preanalysis.reset_caches()
+    router_mod.reset_router()
+    deadline_mod.reset()
+    faults.configure(None)
+
+
+@pytest.fixture(autouse=True)
+def fleet_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("MYTHRIL_TPU_CACHE_DIR", str(tmp_path / "cache"))
+    stats = SolverStatistics()
+    _full_reset()
+    stats.reset()
+    stats.enabled = True
+    saved_cache = global_args.solve_cache
+    saved_heartbeat = global_args.heartbeat
+    global_args.heartbeat = None
+    yield
+    _full_reset()
+    global_args.inject_fault = None
+    global_args.solve_cache = saved_cache
+    global_args.heartbeat = saved_heartbeat
+    stats.reset()
+
+
+def _events(site: str) -> dict:
+    return SolverStatistics().as_dict()["resilience"]["sites"][site]
+
+
+# -- router: deterministic, balanced, minimally disruptive --------------------
+
+
+def test_router_deterministic_and_balanced():
+    """Same digest -> same shard, every time — and over a digest corpus
+    every shard of 4 receives traffic (rendezvous spreads the keyspace)."""
+    from mythril_tpu.fleet.router import ShardRouter, request_digest
+
+    router = ShardRouter(range(4))
+    digests = [request_digest(f"0x60{i:02x}") for i in range(64)]
+    placement = {d: router.route(d) for d in digests}
+    for digest, shard in placement.items():
+        for _ in range(3):
+            assert router.route(digest) == shard
+    assert set(placement.values()) == {0, 1, 2, 3}
+    assert SolverStatistics().fleet_shard_routes == 64 * 4
+
+
+def test_router_rendezvous_minimal_reassignment():
+    """Membership change moves ONLY the dead shard's keys: every digest
+    that did not route to the removed shard keeps its warm shard."""
+    from mythril_tpu.fleet.router import ShardRouter, request_digest
+
+    router = ShardRouter(range(4))
+    digests = [request_digest(f"0x61{i:03x}") for i in range(200)]
+    before = {d: router.route(d) for d in digests}
+    lost = 2
+    after = {d: router.route(d, live=[0, 1, 3]) for d in digests}
+    assert any(shard == lost for shard in before.values())
+    for digest in digests:
+        if before[digest] != lost:
+            assert after[digest] == before[digest], \
+                "an unrelated key moved on membership change"
+        else:
+            assert after[digest] != lost
+
+
+def test_route_fault_degrades_to_round_robin():
+    """Registered site fleet.route (disable): a faulted scorer still
+    places every request on a live shard — round-robin, cycling instead
+    of sticky — and the injection reaches the stats JSON."""
+    from mythril_tpu.fleet.router import ShardRouter, request_digest
+
+    faults.configure("fleet.route:raise:*")
+    router = ShardRouter(range(3))
+    digest = request_digest("0x6001")
+    picks = [router.route(digest) for _ in range(6)]
+    assert all(p in (0, 1, 2) for p in picks)
+    assert len(set(picks)) > 1, \
+        "round-robin degradation must cycle, not stick"
+    recorded = _events("fleet.route")
+    assert recorded["injected"] >= 1
+    assert SolverStatistics().fleet_shard_routes == 6
+
+
+# -- the shared network result tier -------------------------------------------
+
+
+def test_network_tier_entry_stored_by_one_shard_served_by_another(
+        tmp_path, monkeypatch):
+    """Satellite 3, in-process half: with MYTHRIL_TPU_NET_TIER_DIR
+    mounted the engine resolves the NetworkResultStore, a cold daemon
+    populates the shared tier (net_tier_stores), and a SECOND daemon —
+    all in-memory state of the first discarded, a different tenant —
+    re-warms from it with replay-verified hits (net_tier_hits) and
+    identical findings. (The cross-PROCESS half rides the real
+    subprocess fleet test below.)"""
+    from mythril_tpu.serve.daemon import ServeDaemon
+    from mythril_tpu.service.store import get_result_store
+
+    monkeypatch.setenv("MYTHRIL_TPU_NET_TIER_DIR", str(tmp_path / "net"))
+    global_args.solve_cache = "disk"
+    model_mod.clear_caches()  # re-resolve the store handle under the env
+    assert get_result_store().is_network
+    code = wrap_creation(KILLBILLY)
+    stats = SolverStatistics()
+
+    first = ServeDaemon(tx_count=1, deadline_s=120).start()
+    try:
+        cold = first.submit("alice", code).wait(240)
+        assert cold["status"] == "ok"
+        assert stats.net_tier_stores > 0, \
+            "the cold shard must populate the shared tier"
+    finally:
+        assert first.drain(timeout=120.0)
+
+    # shard B: none of shard A's memory, same shared directory
+    model_mod.clear_caches()
+    stats.reset()
+    stats.enabled = True
+    second = ServeDaemon(tx_count=1, deadline_s=120).start()
+    try:
+        warm = second.submit("bob", code).wait(240)
+        assert warm["status"] == "ok"
+        assert warm["issues"] == cold["issues"]
+        assert stats.net_tier_hits > 0, \
+            "the second shard must re-warm from the shared tier"
+        assert warm["cdcl_settles"] < cold["cdcl_settles"]
+    finally:
+        assert second.drain(timeout=120.0)
+
+
+def test_corrupt_shared_entry_quarantined_on_reader_not_writer(tmp_path):
+    """A torn/garbled entry in the shared directory — possibly written
+    by a DIFFERENT shard — is quarantined by the READING store as a safe
+    miss (netstore.entry `quarantine`, net_tier_verify_rejects), and the
+    writing store keeps storing and serving untouched."""
+    from mythril_tpu.fleet.netstore import NetworkResultStore
+
+    root = str(tmp_path / "net")
+    writer = NetworkResultStore(root=root)
+    reader = NetworkResultStore(root=root)
+    fingerprint = "f" * 64
+    assert writer.store_sat(fingerprint, 8, [True] * 9)
+
+    # a sibling shard's torn write lands garbage over the entry
+    with open(writer._path(fingerprint), "w") as fd:
+        fd.write("{torn cross-host write")
+
+    assert reader.lookup(fingerprint) is None, \
+        "a corrupt shared entry must degrade to a miss, never a verdict"
+    assert not os.path.exists(writer._path(fingerprint)), \
+        "the corpse must be moved aside, never re-read"
+    stats = SolverStatistics()
+    assert stats.net_tier_verify_rejects == 1
+    assert stats.persistent_verify_rejects == 1
+    assert _events("netstore.entry")["quarantine"] >= 1
+
+    # the writer's failure domain is untouched: fresh stores round-trip
+    other = "a" * 64
+    assert writer.store_sat(other, 8, [False] * 9)
+    entry = writer.lookup(other)
+    assert entry is not None and entry.verdict == "sat"
+    assert stats.net_tier_verify_rejects == 1
+
+
+def test_injected_netstore_corruption_is_reader_side_safe_miss(tmp_path):
+    """Same degradation through the fault harness: netstore.entry:corrupt
+    garbles the entry at READ time — the store quarantines and misses;
+    with the fault disarmed the next write/read round-trips cleanly."""
+    from mythril_tpu.fleet.netstore import NetworkResultStore
+
+    store = NetworkResultStore(root=str(tmp_path / "net"))
+    fingerprint = "b" * 64
+    assert store.store_unsat(fingerprint, crosschecked=True)
+    faults.configure("netstore.entry:corrupt:*")
+    assert store.lookup(fingerprint) is None
+    recorded = _events("netstore.entry")
+    assert recorded["injected"] >= 1
+    assert recorded["quarantine"] >= 1
+    faults.configure(None)
+    assert store.store_unsat(fingerprint, crosschecked=True)
+    entry = store.lookup(fingerprint)
+    assert entry is not None and entry.verdict == "unsat"
+
+
+def test_injected_netstore_raise_is_quarantined_safe_miss(tmp_path):
+    """The site's `raise` kind (an I/O error mid-read, not garbled
+    bytes) degrades identically: the entry is quarantined and the lookup
+    is a safe miss — a crashing read path must never surface to the
+    solver as anything but a cache miss."""
+    from mythril_tpu.fleet.netstore import NetworkResultStore
+
+    store = NetworkResultStore(root=str(tmp_path / "net"))
+    fingerprint = "c" * 64
+    assert store.store_sat(fingerprint, 4, [True] * 5)
+    faults.configure("netstore.entry:raise:*")
+    assert store.lookup(fingerprint) is None
+    recorded = _events("netstore.entry")
+    assert recorded["injected"] >= 1
+    assert recorded["quarantine"] >= 1
+    faults.configure(None)
+    assert store.store_sat(fingerprint, 4, [True] * 5)
+    entry = store.lookup(fingerprint)
+    assert entry is not None and entry.verdict == "sat"
+
+
+# -- supervisor: stub shards (process machinery without engine cost) ----------
+
+
+class _StubShard:
+    """An in-process stand-in for one worker: a real HTTP server
+    answering the worker surface (/healthz, /snapshot, /analyze) plus a
+    Popen-like handle, injected through the supervisor's spawn seam."""
+
+    def __init__(self, shard_id: int, announce_path: str,
+                 fail_analyze: bool = False):
+        self.shard_id = shard_id
+        self.fail_analyze = fail_analyze
+        self.analyzed = []
+        self._rc = None
+        stub = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _json(self, code, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._json(200, {"status": "ok"})
+                elif self.path == "/snapshot":
+                    self._json(200, stub.snapshot())
+                else:
+                    self._json(404, {})
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", "0"))
+                payload = json.loads(self.rfile.read(length) or b"{}")
+                if self.path == "/analyze":
+                    if stub.fail_analyze:
+                        # die mid-request: force the FIN (close() alone
+                        # leaves the fd alive via rfile/wfile refs)
+                        self.connection.shutdown(socket.SHUT_RDWR)
+                        self.close_connection = True
+                        return
+                    stub.analyzed.append(payload)
+                    self._json(200, {"status": "ok", "issues": [],
+                                     "stub": stub.shard_id})
+                elif self.path == "/evict":
+                    self._json(200, {"status": "ok"})
+                else:
+                    self._json(404, {})
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), _Handler)
+        self._server.daemon_threads = True
+        # induced mid-request deaths are the point; keep stderr quiet
+        self._server.handle_error = lambda *args: None
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+        with open(announce_path, "w") as fd:
+            json.dump({"pid": os.getpid(),
+                       "port": self._server.server_address[1],
+                       "shard_id": shard_id}, fd)
+
+    def snapshot(self) -> dict:
+        from mythril_tpu.observe import metrics
+
+        snap = metrics.snapshot()
+        snap["counters"] = dict(snap["counters"])
+        snap["counters"]["serve_requests_completed"] = len(self.analyzed)
+        snap["counters"]["memory_hits"] = 2 * self.shard_id
+        snap["counters"]["net_tier_hits"] = 10 + self.shard_id
+        return snap
+
+    # Popen-like surface the supervisor drives
+    def poll(self):
+        return self._rc
+
+    def terminate(self):
+        self.kill()
+
+    def kill(self):
+        if self._rc is None:
+            self._rc = 0
+            self._server.shutdown()
+            self._server.server_close()
+
+    def wait(self, timeout=None):
+        return self._rc if self._rc is not None else 0
+
+
+class _StubFleet:
+    """Spawn seam for FleetSupervisor: records every incarnation so
+    tests can kill specific shards and inspect restarts."""
+
+    def __init__(self, fail_analyze=()):
+        self.fail_analyze = set(fail_analyze)
+        self.spawned = []
+
+    def __call__(self, shard_id, announce_path):
+        stub = _StubShard(shard_id, announce_path,
+                          fail_analyze=shard_id in self.fail_analyze)
+        self.spawned.append(stub)
+        return stub
+
+    def current(self, shard_id):
+        return [s for s in self.spawned if s.shard_id == shard_id][-1]
+
+
+def _fleet_post(port, path, payload, timeout=30.0):
+    body = json.dumps(payload).encode()
+    request = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=body,
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(request, timeout=timeout) as response:
+            return response.status, json.load(response)
+    except urllib.error.HTTPError as error:
+        return error.code, json.loads(error.read() or b"{}")
+
+
+def _fleet_get(port, path, timeout=30.0):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout) as response:
+        return response.read().decode()
+
+
+def test_supervisor_sticky_routing_and_fleet_rollup(monkeypatch):
+    """Identical bytecode — even from different tenants — proxies to the
+    SAME shard (the warm-memory affinity the router exists for); /fleetz
+    reads per-shard heat from the shard snapshots and /metrics merges
+    them into one exposition with per-shard heat series."""
+    from mythril_tpu.fleet.supervisor import FleetSupervisor
+
+    monkeypatch.setenv("MYTHRIL_TPU_FLEET_PROBE_INTERVAL", "60")
+    stubs = _StubFleet()
+    fleet = FleetSupervisor(3, http_port=0, spawn=stubs).start()
+    try:
+        outs = [
+            _fleet_post(fleet.port, "/analyze",
+                        {"tenant": tenant, "code": "0x6001"})[1]
+            for tenant in ("alice", "bob", "carol")]
+        assert {out["status"] for out in outs} == {"ok"}
+        assert len({out["shard"] for out in outs}) == 1, \
+            "identical digests must stick to one shard"
+        assert all(out["shard"] == out["stub"] for out in outs)
+
+        health = json.loads(_fleet_get(fleet.port, "/healthz"))
+        assert health["status"] == "ok" and health["live"] == 3
+
+        heat = json.loads(_fleet_get(fleet.port, "/fleetz"))["shards"]
+        assert sum(row["requests_completed"]
+                   for row in heat.values()) == 3
+        hot = str(outs[0]["shard"])
+        assert heat[hot]["requests_completed"] == 3
+
+        text = _fleet_get(fleet.port, "/metrics")
+        for shard_id in range(3):
+            assert (f'mythril_tpu_fleet_shard_requests{{shard='
+                    f'"{shard_id}"}}') in text
+            assert (f'mythril_tpu_fleet_shard_net_tier_hits{{shard='
+                    f'"{shard_id}"}} {10 + shard_id}') in text
+        # merged counters: the three shard snapshots' net-tier hits sum
+        assert "mythril_tpu_net_tier_hits 33" in text
+        assert SolverStatistics().fleet_shard_routes >= 3
+    finally:
+        assert fleet.drain(timeout=30.0)
+    assert fleet.drained.is_set()
+    assert all(stub.poll() is not None for stub in stubs.spawned)
+
+
+def test_fleet_shard_fault_requeues_once_to_survivor(monkeypatch):
+    """Registered site fleet.shard (retry): a shard that dies mid-proxy
+    re-routes the request ONCE to a surviving shard — answered `ok`,
+    `worker_requeue` recorded, fleet_requeues counted — and with every
+    shard failing the fleet answers `incomplete`, never hangs."""
+    from mythril_tpu.fleet.router import request_digest
+    from mythril_tpu.fleet.supervisor import FleetSupervisor
+
+    monkeypatch.setenv("MYTHRIL_TPU_FLEET_PROBE_INTERVAL", "60")
+    code = "0x6002"
+    # make the digest's rendezvous winner the failing shard so the
+    # first proxy attempt is guaranteed to hit it
+    probe = FleetSupervisor(2, spawn=_StubFleet())
+    winner = probe.router.route(request_digest(code))
+    stubs = _StubFleet(fail_analyze={winner})
+    fleet = FleetSupervisor(2, http_port=0, spawn=stubs).start()
+    try:
+        status, out = _fleet_post(fleet.port, "/analyze",
+                                  {"tenant": "alice", "code": code})
+        assert status == 200 and out["status"] == "ok"
+        assert out["shard"] != winner, \
+            "the requeued request must land on the survivor"
+        recorded = _events("fleet.shard")
+        assert recorded["worker_requeue"] >= 1
+        assert SolverStatistics().fleet_requeues >= 1
+
+        # both shards failing: requeue-once then a typed `incomplete`
+        stubs.current(1 - winner).fail_analyze = True
+        status, out = _fleet_post(fleet.port, "/analyze",
+                                  {"tenant": "alice", "code": code})
+        assert status == 504 and out["status"] == "incomplete"
+        assert _events("fleet.shard")["degraded"] >= 1
+    finally:
+        fleet.drain(timeout=30.0)
+
+
+def test_injected_shard_fault_walks_the_full_requeue_discipline(
+        monkeypatch):
+    """fleet.shard:raise through the fault harness (healthy stubs, the
+    proxy crossing itself faults): the injected raise consumes the one
+    requeue, the second attempt faults too, and the fleet answers a
+    typed `incomplete` — then disarming restores normal service on the
+    same fleet, proving the fault left no residue."""
+    from mythril_tpu.fleet.supervisor import FleetSupervisor
+
+    monkeypatch.setenv("MYTHRIL_TPU_FLEET_PROBE_INTERVAL", "60")
+    fleet = FleetSupervisor(2, http_port=0, spawn=_StubFleet()).start()
+    try:
+        faults.configure("fleet.shard:raise:*")
+        status, out = _fleet_post(fleet.port, "/analyze",
+                                  {"tenant": "alice", "code": "0x6005"})
+        assert status == 504 and out["status"] == "incomplete"
+        recorded = _events("fleet.shard")
+        assert recorded["injected"] >= 2
+        assert recorded["worker_requeue"] >= 1
+        assert recorded["degraded"] >= 1
+        assert SolverStatistics().fleet_requeues >= 1
+
+        faults.configure(None)
+        status, out = _fleet_post(fleet.port, "/analyze",
+                                  {"tenant": "alice", "code": "0x6005"})
+        assert status == 200 and out["status"] == "ok"
+    finally:
+        fleet.drain(timeout=30.0)
+
+
+def test_supervisor_crash_only_restarts_dead_shard(monkeypatch):
+    """The health probe notices a dead worker process and crash-only
+    restarts it: a NEW incarnation announces on a new port,
+    fleet_shard_restarts counts it, and the fleet is whole again."""
+    from mythril_tpu.fleet.supervisor import FleetSupervisor
+
+    monkeypatch.setenv("MYTHRIL_TPU_FLEET_PROBE_INTERVAL", "0.2")
+    stubs = _StubFleet()
+    fleet = FleetSupervisor(2, http_port=0, spawn=stubs).start()
+    try:
+        victim = stubs.current(0)
+        victim.kill()
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            health = json.loads(_fleet_get(fleet.port, "/healthz"))
+            if health["shards"]["0"]["restarts"] >= 1 \
+                    and health["shards"]["0"]["alive"]:
+                break
+            time.sleep(0.1)
+        else:
+            pytest.fail("dead shard was never restarted")
+        replacement = stubs.current(0)
+        assert replacement is not victim
+        assert SolverStatistics().fleet_shard_restarts >= 1
+        assert _events("fleet.shard")["retry"] >= 1
+        status, out = _fleet_post(fleet.port, "/analyze",
+                                  {"tenant": "alice", "code": "0x6003"})
+        assert status == 200 and out["status"] == "ok"
+    finally:
+        fleet.drain(timeout=30.0)
+
+
+def test_draining_fleet_rejects_new_requests(monkeypatch):
+    from mythril_tpu.fleet.supervisor import FleetSupervisor
+
+    monkeypatch.setenv("MYTHRIL_TPU_FLEET_PROBE_INTERVAL", "60")
+    fleet = FleetSupervisor(2, http_port=0, spawn=_StubFleet()).start()
+    port = fleet.port
+    assert fleet.drain(timeout=30.0)
+    status, out = fleet.handle_analyze({"code": "0x6004"})
+    assert status == 503
+    assert out == {"status": "rejected", "reason": "draining"}
+    assert port is not None and fleet.drained.is_set()
+
+
+# -- satellite: /metrics is a live scrape, /snapshot feeds the rollup ---------
+
+
+def test_daemon_metrics_scrape_is_live_not_heartbeat_replay():
+    """Two consecutive /metrics scrapes with NO heartbeat configured
+    reflect a counter bump between them — the exposition is rendered
+    from a fresh registry snapshot at scrape time, and the
+    mythril_tpu_snapshot_ts gauge stamps each scrape's snapshot."""
+    from mythril_tpu.serve.daemon import ServeDaemon
+
+    assert global_args.heartbeat is None
+    daemon = ServeDaemon(tx_count=1, deadline_s=120, http_port=0).start()
+    try:
+        first = _fleet_get(daemon.port, "/metrics")
+        assert "mythril_tpu_net_tier_hits 0" in first
+        assert "mythril_tpu_snapshot_ts" in first
+        SolverStatistics().add_net_tier_hit(count=5)
+        second = _fleet_get(daemon.port, "/metrics")
+        assert "mythril_tpu_net_tier_hits 5" in second, \
+            "/metrics replayed stale state instead of a live snapshot"
+
+        snap = json.loads(_fleet_get(daemon.port, "/snapshot"))
+        assert snap["counters"]["net_tier_hits"] == 5
+        assert snap["pid"] == os.getpid()
+        assert snap["final"] is False
+    finally:
+        assert daemon.drain(timeout=120.0)
+
+
+# -- the real thing: subprocess workers, shared tier, kill-a-shard ------------
+
+
+def test_fleet_subprocess_end_to_end_cross_process_tier(
+        tmp_path, monkeypatch):
+    """The acceptance path in miniature: a 2-shard fleet of REAL worker
+    processes behind the supervisor. Identical bytecode from different
+    tenants sticks to one shard with findings byte-identical to the
+    solo-process oracle; after that shard is killed, the SURVIVOR serves
+    the same digest from the shared network tier — a cross-PROCESS
+    replay-verified hit — with the same findings."""
+    from mythril_tpu.fleet.supervisor import FleetSupervisor
+
+    monkeypatch.setenv("MYTHRIL_TPU_NET_TIER_DIR", str(tmp_path / "net"))
+    monkeypatch.setenv("MYTHRIL_TPU_FLEET_PROBE_INTERVAL", "120")
+    code = wrap_creation(KILLBILLY)
+    global_args.solve_cache = "memory"  # oracle must not seed the tier
+    solo = _solo_issues(code)
+    _full_reset()
+
+    fleet = FleetSupervisor(2, tx_count=1, http_port=0).start()
+    try:
+        status, cold = _fleet_post(fleet.port, "/analyze",
+                                   {"tenant": "alice", "code": code},
+                                   timeout=600.0)
+        assert status == 200 and cold["status"] == "ok"
+        assert sorted(json.dumps(i, sort_keys=True)
+                      for i in cold["issues"]) == solo, \
+            "fleet findings must be byte-identical to the solo oracle"
+        hot = cold["shard"]
+
+        status, warm = _fleet_post(fleet.port, "/analyze",
+                                   {"tenant": "bob", "code": code},
+                                   timeout=600.0)
+        assert status == 200 and warm["status"] == "ok"
+        assert warm["shard"] == hot, "identical digests must stick"
+        assert sorted(json.dumps(i, sort_keys=True)
+                      for i in warm["issues"]) == solo
+
+        heat = json.loads(_fleet_get(fleet.port, "/fleetz"))["shards"]
+        assert heat[str(hot)]["requests_completed"] == 2
+        assert heat[str(hot)]["net_tier_stores"] > 0, \
+            "the hot shard must populate the shared tier"
+
+        # kill the hot shard: the survivor owns the digest now and
+        # re-warms from the tier the dead shard wrote — cross-process
+        fleet._shards[hot].proc.kill()
+        fleet._shards[hot].proc.wait(timeout=30.0)
+        status, failover = _fleet_post(fleet.port, "/analyze",
+                                       {"tenant": "carol", "code": code},
+                                       timeout=600.0)
+        assert status == 200 and failover["status"] == "ok"
+        survivor = failover["shard"]
+        assert survivor != hot
+        assert sorted(json.dumps(i, sort_keys=True)
+                      for i in failover["issues"]) == solo, \
+            "a tier-served verdict must replay to the same findings"
+        heat = json.loads(_fleet_get(fleet.port, "/fleetz"))["shards"]
+        assert heat[str(survivor)]["net_tier_hits"] > 0, \
+            "the survivor must hit entries the dead shard stored"
+
+        text = _fleet_get(fleet.port, "/metrics")
+        assert f'mythril_tpu_fleet_shard_requests{{shard="{survivor}"}}' \
+            in text
+        assert SolverStatistics().fleet_shard_routes >= 3
+    finally:
+        fleet.drain(timeout=60.0)

@@ -78,6 +78,15 @@ TRAJECTORY_METRICS = (
     # (contamination / dirty drain) would be a regression
     "serve.warm_requests_per_hour",
     "serve.zero_contamination",
+    # sharded fleet: 4-shard warm throughput and its scaling over one
+    # shard are THE fleet numbers; cross-process net-tier hits going
+    # dark means the shards stopped sharing warmth, and the containment
+    # verdicts (parity with the single-process oracle, zero lost
+    # requests) flipping false is a regression
+    "fleet.warm_requests_per_hour_4shard",
+    "fleet.warm_speedup_4v1",
+    "fleet.net_tier_hits_4shard",
+    "fleet.zero_contamination",
     # autotune loop: the tuned-vs-default paired leg — speedup dropping
     # (or findings parity flipping) means the persisted profile went
     # stale and must be re-tuned; the trajectory table catches it
@@ -106,6 +115,9 @@ _HIGHER_BETTER_RE = re.compile(
     r"|per_hour|xcontract"
     # serve daemon: containment verdicts flipping false is a regression
     r"|zero_contamination|clean_drain"
+    # sharded fleet: the cross-process warmth evidence and the
+    # zero-lost-requests verdict both want to stay up
+    r"|net_tier_hits|net_tier_stores|zero_lost"
     # autotune: the tuned profile going dark (knobs_applied -> 0)
     # silently reverts every leg to built-in defaults
     r"|knobs_applied"
@@ -117,6 +129,8 @@ _HIGHER_BETTER_RE = re.compile(
 _LOWER_BETTER_RE = re.compile(
     r"(_s$|wall|cap_rejects|cdcl_settles|sol_gap|misses|fallbacks"
     r"|verify_rejects|degraded|deadline_trips|breaker_trips"
+    # fleet requeues/restarts: each one is a shard fault the fleet paid
+    r"|requeues|restarts"
     # per-window-shape kernel recompiles: every one is a paid jit
     r"|recompiles)")
 
@@ -238,6 +252,25 @@ def extract_metrics(payload: dict) -> Dict[str, object]:
     put("serve.p99_admission_s", serve.get("p99_admission_s"))
     put("serve.zero_contamination", serve.get("zero_contamination"))
     put("serve.clean_drain", serve.get("clean_drain"))
+    fleet = extra.get("fleet") or {}
+    for label, suffix in (("one_shard", "1shard"),
+                          ("four_shard", "4shard")):
+        width = fleet.get(label) or {}
+        put(f"fleet.warm_requests_per_hour_{suffix}",
+            width.get("warm_requests_per_hour"))
+        put(f"fleet.net_tier_hits_{suffix}",
+            width.get("net_tier_hits"))
+        put(f"fleet.net_tier_stores_{suffix}",
+            width.get("net_tier_stores"))
+        put(f"fleet.p99_admission_s_{suffix}",
+            width.get("p99_admission_s"))
+        put(f"fleet.requeues_{suffix}", width.get("requeues"))
+        put(f"fleet.shard_restarts_{suffix}",
+            width.get("shard_restarts"))
+    put("fleet.warm_speedup_4v1", fleet.get("warm_speedup_4v1"))
+    put("fleet.zero_contamination", fleet.get("zero_contamination"))
+    put("fleet.zero_lost", fleet.get("zero_lost"))
+    put("fleet.clean_drain", fleet.get("clean_drain"))
     tuned = extra.get("tuned_vs_default") or {}
     put("tuned.default_wall_s", tuned.get("default_wall_s"))
     put("tuned.tuned_wall_s", tuned.get("tuned_wall_s"))

@@ -15,8 +15,13 @@ typed registry exists to kill. Five invariants:
      "<site>" crossing somewhere under mythril_tpu/ — a site the code
      never crosses can never degrade, so its chaos tests are vacuous;
   3. every registered fault site is EXERCISED by the chaos/resilience
-     suite: its name appears in tests/test_chaos.py or
-     tests/test_resilience.py;
+     suite: its name appears in tests/test_chaos.py,
+     tests/test_resilience.py, or tests/test_fleet.py (the fleet sites
+     cross process boundaries, so their chaos tests live with the
+     fleet suite); additionally the fleet sites (fleet.shard,
+     fleet.route, netstore.entry) must ALL be registered — the sharded
+     serve fleet without typed fault sites would be exactly the
+     untyped failure plane the registry exists to kill;
   4. every crossing in the code names a REGISTERED site (no orphan
      maybe_inject("typo.site") silently injecting nothing);
   5. every resilience event counter rolls up end to end: each scalar in
@@ -113,8 +118,10 @@ def main(argv) -> int:
                 f"{site} ({crossings[site][0]})" for site in orphans))
 
     # 3. chaos coverage: every site named in the chaos/resilience suite
+    # (the fleet sites' chaos tests live with the fleet suite)
     tested = set()
-    for test_name in ("test_chaos.py", "test_resilience.py"):
+    for test_name in ("test_chaos.py", "test_resilience.py",
+                      "test_fleet.py"):
         test_path = os.path.join(root, "tests", test_name)
         if not os.path.isfile(test_path):
             continue
@@ -127,8 +134,16 @@ def main(argv) -> int:
     if untested:
         failures.append(
             "registered fault sites with no chaos test naming them "
-            "(tests/test_chaos.py / tests/test_resilience.py): "
-            + ", ".join(untested))
+            "(tests/test_chaos.py / tests/test_resilience.py / "
+            "tests/test_fleet.py): " + ", ".join(untested))
+    missing_fleet = sorted(
+        {"fleet.shard", "fleet.route", "netstore.entry"}
+        - set(registry.FAULT_SITES))
+    if missing_fleet:
+        failures.append(
+            "the sharded-fleet fault sites must be registered "
+            "(fleet.shard / fleet.route / netstore.entry); missing: "
+            + ", ".join(missing_fleet))
 
     # 5. counter roll-up end to end
     bench = _load_bench(root)

@@ -256,6 +256,48 @@ def main(argv) -> int:
     probe.reset()
     probe.enabled = was_enabled
 
+    # 10. the sharded-fleet counters: pinned BY NAME like invariants
+    # 8/9 — the fleet supervisor's routing/requeue/restart ledger and
+    # the shared network-tier hit/store/reject ledger must reach every
+    # consumer (stats JSON, bench roll-up), and every adder must
+    # actually advance its counter — these cross PROCESS boundaries
+    # (supervisor-side vs shard-side), so a silently-dead adder would
+    # make the fleet heat map and the bench fleet leg report zeros
+    # while looking wired
+    from mythril_tpu.smt.solver.statistics import FLEET_COUNTERS
+
+    for name in FLEET_COUNTERS:
+        if name not in fields:
+            failures.append(
+                f"pinned fleet counter {name!r} is not a "
+                "SolverStatistics field")
+        if name not in emitted:
+            failures.append(
+                f"pinned fleet counter {name!r} missing from the "
+                "stats JSON emission (as_dict)")
+        if name not in routed:
+            failures.append(
+                f"pinned fleet counter {name!r} missing from "
+                "bench.py ROUTING_KEYS roll-up")
+    probe.reset()
+    probe.enabled = True
+    probe.add_fleet_route()
+    probe.add_fleet_route(count=2)
+    probe.add_fleet_requeue()
+    probe.add_fleet_shard_restart()
+    probe.add_net_tier_hit(count=3)
+    probe.add_net_tier_store(count=2)
+    probe.add_net_tier_verify_reject()
+    observed = tuple(getattr(probe, name) for name in FLEET_COUNTERS)
+    expected = (3, 1, 1, 3, 2, 1)
+    if observed != expected:
+        failures.append(
+            "fleet counter adders do not advance their counters "
+            f"({dict(zip(FLEET_COUNTERS, observed))}, expected "
+            f"{dict(zip(FLEET_COUNTERS, expected))})")
+    probe.reset()
+    probe.enabled = was_enabled
+
     registered = {inst.name for inst in metrics.REGISTRY}
     unregistered = sorted(set(fields) - registered)
     if unregistered:

@@ -23,13 +23,30 @@ Phases (one process, one daemon — the warm-tier contrast is the point):
   warm   each contract once more, faults disarmed: warm requests/hour
          and the memo-reuse evidence (memo hits, settle shrinkage)
 
+FLEET MODE (--shards N): the same three invariants asserted against the
+sharded fleet (mythril_tpu/fleet/) instead of one in-process daemon —
+N REAL worker processes behind the supervisor's digest-keyed router,
+sharing one network result tier. The parity oracle comes FIRST: every
+contract's reference findings are computed by a single-process daemon
+(memory-only cache, so the oracle never seeds the shared tier), and
+every fleet answer — cold, soak, warm — must match it byte-for-byte in
+witness-masked canonical form. Extra fleet reporting: per-shard p99
+admission latency, the shard heat map (requests + warm-hit rate +
+net-tier hits per shard, read from GET /fleetz), and the fleet-wide
+net-tier hit/store tallies. --chaos-kill-shard SIGKILLs the hottest
+shard mid-soak and asserts the drain/requeue discipline absorbed it:
+zero lost requests (every request gets a terminal answer), findings
+parity on every `ok`, and the fleet recorded requeues and a crash-only
+restart.
+
 Usage:
   python tools/soak_serve.py [--clients 4] [--requests-per-client 2]
       [--faults SPEC] [--seed 0] [--corpus DIR] [--deadline 60]
-      [--check] [--p99-bound 30]
+      [--shards N] [--chaos-kill-shard] [--check] [--p99-bound 30]
 
 Prints one JSON object; --check exits 1 on contamination / dirty drain /
-p99 past the bound. bench.py's serve leg runs this with small counts.
+p99 past the bound (fleet mode adds: lost requests, missing chaos
+evidence). bench.py's serve and fleet legs run this with small counts.
 """
 
 import argparse
@@ -206,6 +223,234 @@ def run_soak(clients: int = 4, requests_per_client: int = 2,
     return result
 
 
+def _percentile_99(samples) -> float:
+    samples = sorted(samples)
+    return samples[max(0, int(len(samples) * 0.99) - 1)] \
+        if samples else 0.0
+
+
+def _solo_reference(contracts, deadline_s: float, tx_count: int) -> dict:
+    """The parity oracle: per-contract canonical findings from ONE
+    single-process daemon with a memory-only cache — the oracle must
+    never seed the shared network tier the fleet is being graded on."""
+    from mythril_tpu.serve.daemon import ServeDaemon
+    from mythril_tpu.support import model as model_mod
+    from mythril_tpu.support.args import args as global_args
+
+    saved_cache = global_args.solve_cache
+    global_args.solve_cache = "memory"
+    reference = {}
+    daemon = ServeDaemon(tx_count=tx_count, deadline_s=deadline_s).start()
+    try:
+        for name, code in contracts:
+            outcome = daemon.submit("oracle", code, name=name).wait(
+                2 * deadline_s + 60)
+            if outcome is None or outcome["status"] != "ok":
+                raise SystemExit(
+                    f"oracle request for {name} failed: {outcome}")
+            reference[name] = _canonical(outcome["issues"])
+    finally:
+        daemon.drain(timeout=max(120.0, 2 * deadline_s))
+        global_args.solve_cache = saved_cache
+        model_mod.clear_caches()
+    return reference
+
+
+def run_fleet_soak(shards: int, clients: int = 4,
+                   requests_per_client: int = 2, faults_spec: str = "",
+                   seed: int = 0, corpus_dir: str = None,
+                   deadline_s: float = 60.0, tx_count: int = 1,
+                   chaos_kill_shard: bool = False) -> dict:
+    """The fleet harness: oracle -> cold -> soak (optional kill-a-shard
+    chaos) -> warm, all through the supervisor's HTTP front."""
+    import tempfile
+
+    from mythril_tpu.fleet.supervisor import FleetSupervisor
+    from mythril_tpu.resilience import faults
+    from mythril_tpu.smt.solver.statistics import SolverStatistics
+
+    corpus_dir = corpus_dir or os.path.join(REPO_ROOT, "bench_inputs",
+                                            "corpus")
+    files = sorted(glob.glob(os.path.join(corpus_dir, "*.hex")))
+    if not files:
+        raise SystemExit(f"no corpus under {corpus_dir} "
+                         "(run tools/make_corpus.py --write)")
+    contracts = [(os.path.basename(path),
+                  open(path).read().strip()) for path in files]
+    os.environ.setdefault("MYTHRIL_TPU_FAULT_SEED", str(seed))
+    net_tier = os.environ.get("MYTHRIL_TPU_NET_TIER_DIR")
+    if not net_tier:
+        net_tier = tempfile.mkdtemp(prefix="mythril-net-tier-")
+        os.environ["MYTHRIL_TPU_NET_TIER_DIR"] = net_tier
+
+    stats = SolverStatistics()
+    stats.reset()
+    stats.enabled = True
+    reference = _solo_reference(contracts, deadline_s, tx_count)
+
+    fleet = FleetSupervisor(shards, tx_count=tx_count,
+                            http_port=0).start()
+    request_timeout = 2 * deadline_s + 90
+    result = {"mode": "fleet", "shards": shards,
+              "contracts": len(contracts), "clients": clients,
+              "faults": faults_spec or None, "seed": seed,
+              "net_tier_dir": net_tier,
+              "chaos_kill_shard": chaos_kill_shard}
+    contamination = []
+    try:
+        # -- cold phase: the whole corpus through the front door --------------
+        cold_start = time.monotonic()
+        shard_of = {}
+        for name, code in contracts:
+            outcome = _post_analyze(
+                fleet.port, {"tenant": "reference", "code": code,
+                             "name": name, "tx_count": tx_count,
+                             "deadline_s": deadline_s},
+                timeout=request_timeout)
+            if outcome.get("status") != "ok":
+                raise SystemExit(
+                    f"cold fleet request for {name} failed: {outcome}")
+            shard_of[name] = outcome.get("shard")
+            if _canonical(outcome["issues"]) != reference[name]:
+                contamination.append({"client": "cold", "contract": name})
+        cold_wall = time.monotonic() - cold_start
+
+        # -- soak phase: concurrent clients; optionally kill a shard ----------
+        faults.configure(faults_spec or None)
+        tallies = {"ok": 0, "error": 0, "incomplete": 0, "rejected": 0}
+        lost = []
+        waits_by_shard = {}
+        lock = threading.Lock()
+
+        def client(ci: int) -> None:
+            for ri in range(requests_per_client):
+                name, code = contracts[(ci + ri) % len(contracts)]
+                try:
+                    outcome = _post_analyze(
+                        fleet.port,
+                        {"tenant": f"client{ci}", "code": code,
+                         "name": name, "tx_count": tx_count,
+                         "deadline_s": deadline_s},
+                        timeout=request_timeout)
+                except Exception as error:
+                    with lock:
+                        lost.append({"client": ci, "contract": name,
+                                     "error": repr(error)})
+                    continue
+                with lock:
+                    tallies[outcome.get("status", "error")] = \
+                        tallies.get(outcome.get("status", "error"), 0) + 1
+                    if "wait_s" in outcome:
+                        waits_by_shard.setdefault(
+                            outcome.get("shard"), []).append(
+                                outcome["wait_s"])
+                    if outcome.get("status") == "ok" \
+                            and _canonical(outcome["issues"]) \
+                            != reference[name]:
+                        contamination.append(
+                            {"client": ci, "contract": name})
+
+        soak_start = time.monotonic()
+        threads = [threading.Thread(target=client, args=(ci,), daemon=True)
+                   for ci in range(clients)]
+        for thread in threads:
+            thread.start()
+        chaos = {}
+        if chaos_kill_shard:
+            # SIGKILL the hottest shard while the storm is in flight;
+            # the supervisor must requeue its in-flight requests to
+            # survivors and crash-only restart it
+            victim = max(
+                range(shards),
+                key=lambda sid: sum(1 for shard in shard_of.values()
+                                    if shard == sid))
+            time.sleep(0.5)  # let the storm land on the fleet first
+            fleet._shards[victim].proc.kill()
+            chaos["killed_shard"] = victim
+        for thread in threads:
+            thread.join()
+        soak_wall = time.monotonic() - soak_start
+        faults.configure(None)
+        if chaos_kill_shard:
+            # the probe must bring the victim back before the warm phase
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                health = fleet.healthz()
+                if health["live"] == shards:
+                    break
+                time.sleep(0.25)
+            chaos["restarts"] = stats.fleet_shard_restarts
+            chaos["requeues"] = stats.fleet_requeues
+            chaos["refleet_live"] = fleet.healthz()["live"]
+
+        # -- warm phase: corpus again, heat map from /fleetz ------------------
+        warm_start = time.monotonic()
+        for name, code in contracts:
+            outcome = _post_analyze(
+                fleet.port, {"tenant": "reference", "code": code,
+                             "name": name, "tx_count": tx_count,
+                             "deadline_s": deadline_s},
+                timeout=request_timeout)
+            if outcome.get("status") != "ok":
+                raise SystemExit(
+                    f"warm fleet request for {name} failed: {outcome}")
+            if _canonical(outcome["issues"]) != reference[name]:
+                contamination.append({"client": "warm", "contract": name})
+        warm_wall = time.monotonic() - warm_start
+
+        heat = {}
+        net_tier_hits = net_tier_stores = 0
+        for shard_id, row in fleet.fleetz()["shards"].items():
+            completed = row.get("requests_completed", 0)
+            warm_hits = (row.get("memo_hits", 0)
+                         + row.get("persistent_hits", 0))
+            heat[shard_id] = {
+                "requests": completed,
+                "warm_hits": warm_hits,
+                "warm_hit_rate": (round(warm_hits / completed, 3)
+                                  if completed else 0.0),
+                "net_tier_hits": row.get("net_tier_hits", 0),
+                "net_tier_stores": row.get("net_tier_stores", 0),
+                "restarts": row.get("restarts", 0),
+                "p99_admission_s": round(_percentile_99(
+                    waits_by_shard.get(int(shard_id), [])), 4),
+            }
+            net_tier_hits += row.get("net_tier_hits", 0)
+            net_tier_stores += row.get("net_tier_stores", 0)
+
+        all_waits = [w for shard in waits_by_shard.values()
+                     for w in shard]
+        result.update({
+            "soak_requests": clients * requests_per_client,
+            "tallies": tallies,
+            "lost": lost,
+            "contamination": contamination,
+            "chaos": chaos or None,
+            "shard_heat": heat,
+            "net_tier_hits": net_tier_hits,
+            "net_tier_stores": net_tier_stores,
+            "fleet_requeues": stats.fleet_requeues,
+            "fleet_shard_restarts": stats.fleet_shard_restarts,
+            "soak_wall_s": round(soak_wall, 2),
+            "p99_admission_s": round(_percentile_99(all_waits), 4),
+            "cold_wall_s": round(cold_wall, 2),
+            "warm_wall_s": round(warm_wall, 2),
+            "cold_requests_per_hour": (
+                round(3600.0 * len(contracts) / cold_wall, 1)
+                if cold_wall else None),
+            "warm_requests_per_hour": (
+                round(3600.0 * len(contracts) / warm_wall, 1)
+                if warm_wall else None),
+            "warm_speedup": (round(cold_wall / warm_wall, 3)
+                             if warm_wall else None),
+        })
+    finally:
+        faults.configure(None)
+        result["clean_drain"] = fleet.drain(
+            timeout=max(120.0, 2 * deadline_s))
+    return result
+
+
 def main(argv) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--clients", type=int, default=4)
@@ -217,17 +462,35 @@ def main(argv) -> int:
     parser.add_argument("--corpus", default=None)
     parser.add_argument("--deadline", type=float, default=60.0)
     parser.add_argument("--tx", type=int, default=1)
+    parser.add_argument("--shards", type=int, default=None,
+                        help="run the sharded FLEET (N worker processes "
+                             "behind the supervisor) instead of one "
+                             "in-process daemon")
+    parser.add_argument("--chaos-kill-shard", action="store_true",
+                        help="fleet mode: SIGKILL the hottest shard "
+                             "mid-soak and assert drain/requeue parity")
     parser.add_argument("--check", action="store_true",
                         help="exit 1 on contamination, dirty drain, or "
                              "p99 admission latency past --p99-bound")
     parser.add_argument("--p99-bound", type=float, default=30.0,
                         help="seconds (with --check)")
     parsed = parser.parse_args(argv[1:])
-    result = run_soak(clients=parsed.clients,
-                      requests_per_client=parsed.requests_per_client,
-                      faults_spec=parsed.faults, seed=parsed.seed,
-                      corpus_dir=parsed.corpus,
-                      deadline_s=parsed.deadline, tx_count=parsed.tx)
+    if parsed.chaos_kill_shard and not parsed.shards:
+        parser.error("--chaos-kill-shard requires --shards N")
+    if parsed.shards:
+        result = run_fleet_soak(
+            shards=parsed.shards, clients=parsed.clients,
+            requests_per_client=parsed.requests_per_client,
+            faults_spec=parsed.faults, seed=parsed.seed,
+            corpus_dir=parsed.corpus, deadline_s=parsed.deadline,
+            tx_count=parsed.tx,
+            chaos_kill_shard=parsed.chaos_kill_shard)
+    else:
+        result = run_soak(clients=parsed.clients,
+                          requests_per_client=parsed.requests_per_client,
+                          faults_spec=parsed.faults, seed=parsed.seed,
+                          corpus_dir=parsed.corpus,
+                          deadline_s=parsed.deadline, tx_count=parsed.tx)
     print(json.dumps(result))
     if parsed.check:
         if result["contamination"]:
@@ -240,6 +503,20 @@ def main(argv) -> int:
             print(f"FAIL: p99 admission {result['p99_admission_s']}s "
                   f"> {parsed.p99_bound}s", file=sys.stderr)
             return 1
+        if result.get("lost"):
+            print(f"FAIL: {len(result['lost'])} lost request(s) — every "
+                  "request must get a terminal answer", file=sys.stderr)
+            return 1
+        if parsed.chaos_kill_shard:
+            chaos = result.get("chaos") or {}
+            if chaos.get("refleet_live", 0) < parsed.shards:
+                print("FAIL: killed shard was never restarted",
+                      file=sys.stderr)
+                return 1
+            if not result["fleet_shard_restarts"]:
+                print("FAIL: kill-a-shard chaos recorded no restart",
+                      file=sys.stderr)
+                return 1
     return 0
 
 
